@@ -1,0 +1,46 @@
+"""Structured logging facade.
+
+Mirrors the reference's slog wrapper (ref: pkg/log/logger.go:20-28): a thin
+layer over :mod:`logging` with per-subsystem prefixes, ``--debug``/``--quiet``
+switches, and deferred configuration so library code can log before the CLI
+has parsed flags.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_ROOT_NAME = "trivy_tpu"
+_configured = False
+
+
+def logger(prefix: str | None = None) -> logging.Logger:
+    """Return the framework logger, optionally namespaced by subsystem."""
+    name = _ROOT_NAME if not prefix else f"{_ROOT_NAME}.{prefix}"
+    return logging.getLogger(name)
+
+
+def init(debug: bool = False, quiet: bool = False, stream=None) -> None:
+    """Configure the root framework logger once (idempotent re-config allowed)."""
+    global _configured
+    root = logging.getLogger(_ROOT_NAME)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s [%(name)s] %(message)s", "%H:%M:%S")
+    )
+    root.addHandler(handler)
+    if quiet:
+        root.setLevel(logging.ERROR)
+    elif debug:
+        root.setLevel(logging.DEBUG)
+    else:
+        root.setLevel(logging.INFO)
+    root.propagate = False
+    _configured = True
+
+
+def is_configured() -> bool:
+    return _configured

@@ -1,0 +1,22 @@
+"""Secret scanning engine.
+
+Behavioral model: the reference's ``pkg/fanal/secret`` (scanner at ref:
+pkg/fanal/secret/scanner.go:377-463): per-file keyword prefilter, per-rule
+regex matching with allow-rules, exclude blocks, censoring and ±2-line code
+context. Here the hot loop is re-architected for TPU: a batched keyword
+prefilter (one-hot matmul on the MXU) plus a multi-pattern DFA over fixed-size
+overlapping chunks, with exact host-side confirmation so findings stay
+byte-identical to the pure-CPU engine.
+"""
+
+from trivy_tpu.secret.rules import AllowRule, Rule, builtin_allow_rules, builtin_rules
+from trivy_tpu.secret.engine import SecretScanner, ScannerConfig
+
+__all__ = [
+    "AllowRule",
+    "Rule",
+    "builtin_allow_rules",
+    "builtin_rules",
+    "SecretScanner",
+    "ScannerConfig",
+]

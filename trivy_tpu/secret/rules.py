@@ -1,0 +1,380 @@
+"""Secret rule model and built-in ruleset.
+
+Rule semantics follow the reference's model (ref: pkg/fanal/secret/scanner.go:89-100):
+each rule has an ID/category/severity/title, a detection regex, a keyword
+prefilter list (cheap lowercase substring check before the regex runs), an
+optional path regex restricting which files it applies to, optional per-rule
+allow rules, an optional exclude-block regex suppressing matches inside
+matching block spans, and an optional named group selecting the secret span
+within the regex match.
+
+The built-in ruleset covers the same secret families as the reference's 87
+built-in rules (ref: pkg/fanal/secret/builtin-rules.go) — cloud provider keys,
+VCS tokens, SaaS API keys, private-key blocks — written independently from the
+public token formats. Keywords are chosen to be substrings of any match so the
+TPU keyword prefilter (exact substring search on device) is sound: a chunk
+with no keyword hit can be skipped without running the regex at all.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from functools import cached_property
+
+from trivy_tpu.types import Severity
+
+# Matches must not start mid-word: a token preceded by [0-9a-zA-Z] is part of a
+# longer word and not a credential boundary (ref: builtin-rules.go:81 startWord).
+_WORD_PREFIX = r"(?:^|[^0-9a-zA-Z])"
+
+# Name of the regex group holding the secret when a rule wraps its payload.
+SECRET_GROUP = "secret"
+
+
+def ws(pattern: str) -> str:
+    """Wrap ``pattern`` so it only matches at a word start, capturing the
+    payload in the ``secret`` group. Mirrors the reference's
+    ``MustCompileWithoutWordPrefix`` (ref: pkg/fanal/secret/scanner.go:66-68)."""
+    return f"{_WORD_PREFIX}(?P<{SECRET_GROUP}>{pattern})"
+
+
+@dataclass
+class AllowRule:
+    """Suppression rule (ref: pkg/fanal/secret/builtin-allow-rules.go).
+
+    ``path``: files whose path matches are skipped entirely.
+    ``regex``: tested against the *extracted secret text* of each candidate
+    location (ref: scanner.go AllowLocation semantics); a match suppresses the
+    finding. Anchors (``^``/``$``) therefore refer to the secret's own bounds.
+    """
+
+    id: str
+    description: str = ""
+    path: str | None = None
+    regex: str | None = None
+
+    @cached_property
+    def path_re(self) -> re.Pattern | None:
+        return re.compile(self.path) if self.path else None
+
+    @cached_property
+    def regex_re(self) -> re.Pattern | None:
+        return re.compile(self.regex) if self.regex else None
+
+
+@dataclass
+class Rule:
+    id: str
+    category: str
+    title: str
+    severity: Severity
+    regex: str
+    keywords: list[str] = field(default_factory=list)
+    path: str | None = None
+    secret_group_name: str | None = None
+    allow_rules: list[AllowRule] = field(default_factory=list)
+    exclude_blocks: list[str] = field(default_factory=list)
+
+    @cached_property
+    def regex_re(self) -> re.Pattern:
+        return re.compile(self.regex)
+
+    @cached_property
+    def path_re(self) -> re.Pattern | None:
+        return re.compile(self.path) if self.path else None
+
+    @cached_property
+    def exclude_block_res(self) -> list[re.Pattern]:
+        return [re.compile(p) for p in self.exclude_blocks]
+
+    @cached_property
+    def lower_keywords(self) -> list[str]:
+        return [k.lower() for k in self.keywords]
+
+    def match_path(self, path: str) -> bool:
+        return self.path_re is None or self.path_re.search(path) is not None
+
+    def allow_path(self, path: str) -> bool:
+        return any(a.path_re and a.path_re.search(path) for a in self.allow_rules)
+
+    def match_keywords(self, lower_content: str) -> bool:
+        """Cheap prefilter: any keyword present (lowercased substring), or no
+        keywords at all (ref: scanner.go:174-186)."""
+        if not self.lower_keywords:
+            return True
+        return any(k in lower_content for k in self.lower_keywords)
+
+
+def _r(
+    id: str,
+    category: str,
+    title: str,
+    severity: Severity,
+    regex: str,
+    keywords: list[str],
+    **kw,
+) -> Rule:
+    return Rule(
+        id=id, category=category, title=title, severity=severity, regex=regex,
+        keywords=keywords, **kw,
+    )
+
+
+CategoryAWS = "AWS"
+CategoryGitHub = "GitHub"
+CategoryGitLab = "GitLab"
+CategoryAsymmetricPrivateKey = "AsymmetricPrivateKey"
+CategoryGoogle = "Google"
+CategorySlack = "Slack"
+CategoryStripe = "Stripe"
+CategoryShopify = "Shopify"
+CategoryGeneric = "Generic"
+
+
+def builtin_rules() -> list[Rule]:
+    """The built-in ruleset. Order is significant only for output sorting."""
+    S = Severity
+    rules: list[Rule] = [
+        # ----- cloud providers -------------------------------------------------
+        _r("aws-access-key-id", CategoryAWS, "AWS Access Key ID", S.CRITICAL,
+           ws(r"(?:A3T[0-9A-Z]|AKIA|AGPA|AIDA|AROA|AIPA|ANPA|ANVA|ASIA)[0-9A-Z]{16}"),
+           ["AKIA", "AGPA", "AIDA", "AROA", "AIPA", "ANPA", "ANVA", "ASIA"],
+           secret_group_name=SECRET_GROUP,
+           allow_rules=[AllowRule(id="aws-example-key",
+                                  description="AWS documentation example keys",
+                                  regex=r"EXAMPLE")]),
+        _r("aws-secret-access-key", CategoryAWS, "AWS Secret Access Key", S.CRITICAL,
+           r"(?i)(?:^|[^0-9a-zA-Z])aws[_\-\.]{0,25}(?:secret|sk)?[_\-\.]{0,25}"
+           r"(?:access)?[_\-\.]{0,25}key(?:[_\-\.]{0,2}id)?[\s:=\"']{1,10}"
+           r"(?P<secret>[0-9a-zA-Z/+]{40})(?:[^0-9a-zA-Z/+]|$)",
+           ["aws"], secret_group_name=SECRET_GROUP,
+           allow_rules=[AllowRule(id="aws-example-secret",
+                                  description="AWS documentation example secrets",
+                                  regex=r"EXAMPLEKEY")]),
+        _r("aws-mws-key", CategoryAWS, "AWS Marketplace Web Service key", S.HIGH,
+           ws(r"amzn\.mws\.[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12}"),
+           ["amzn.mws"], secret_group_name=SECRET_GROUP),
+        _r("gcp-api-key", CategoryGoogle, "Google API key", S.HIGH,
+           ws(r"AIza[0-9A-Za-z_\-]{35}"), ["AIza"], secret_group_name=SECRET_GROUP),
+        _r("gcp-service-account", CategoryGoogle, "Google service account credentials", S.CRITICAL,
+           r"\"type\"\s*:\s*\"service_account\"", ["service_account"]),
+        _r("alibaba-access-key-id", "Alibaba", "Alibaba Cloud AccessKey ID", S.HIGH,
+           ws(r"LTAI[0-9a-zA-Z]{12,24}"), ["LTAI"], secret_group_name=SECRET_GROUP),
+        _r("azure-storage-account-key", "Azure", "Azure Storage account key", S.CRITICAL,
+           r"(?i)AccountKey\s*=\s*(?P<secret>[0-9a-zA-Z+/=]{88})",
+           ["AccountKey"], secret_group_name=SECRET_GROUP),
+        _r("digitalocean-pat", "DigitalOcean", "DigitalOcean personal access token", S.CRITICAL,
+           ws(r"dop_v1_[a-f0-9]{64}"), ["dop_v1_"], secret_group_name=SECRET_GROUP),
+        _r("digitalocean-oauth-token", "DigitalOcean", "DigitalOcean OAuth token", S.CRITICAL,
+           ws(r"doo_v1_[a-f0-9]{64}"), ["doo_v1_"], secret_group_name=SECRET_GROUP),
+        _r("digitalocean-refresh-token", "DigitalOcean", "DigitalOcean refresh token", S.HIGH,
+           ws(r"dor_v1_[a-f0-9]{64}"), ["dor_v1_"], secret_group_name=SECRET_GROUP),
+        _r("heroku-api-key", "Heroku", "Heroku API key", S.HIGH,
+           r"(?i)heroku[a-z0-9_\-\s\"']{0,25}(?:=|>|:=|\|\|:|<=|=>|:)[\s\"']{0,5}"
+           r"(?P<secret>[0-9a-f]{8}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{4}-[0-9a-f]{12})",
+           ["heroku"], secret_group_name=SECRET_GROUP),
+        # ----- VCS / forges ----------------------------------------------------
+        _r("github-pat", CategoryGitHub, "GitHub personal access token", S.CRITICAL,
+           ws(r"ghp_[0-9a-zA-Z]{36}"), ["ghp_"], secret_group_name=SECRET_GROUP),
+        _r("github-oauth-token", CategoryGitHub, "GitHub OAuth access token", S.CRITICAL,
+           ws(r"gho_[0-9a-zA-Z]{36}"), ["gho_"], secret_group_name=SECRET_GROUP),
+        _r("github-app-token", CategoryGitHub, "GitHub app token", S.CRITICAL,
+           ws(r"(?:ghu|ghs)_[0-9a-zA-Z]{36}"), ["ghu_", "ghs_"], secret_group_name=SECRET_GROUP),
+        _r("github-refresh-token", CategoryGitHub, "GitHub refresh token", S.CRITICAL,
+           ws(r"ghr_[0-9a-zA-Z]{76}"), ["ghr_"], secret_group_name=SECRET_GROUP),
+        _r("github-fine-grained-pat", CategoryGitHub, "GitHub fine-grained personal access token",
+           S.CRITICAL, ws(r"github_pat_[0-9a-zA-Z_]{82}"), ["github_pat_"],
+           secret_group_name=SECRET_GROUP),
+        _r("gitlab-pat", CategoryGitLab, "GitLab personal access token", S.CRITICAL,
+           ws(r"glpat-[0-9a-zA-Z_\-]{20}"), ["glpat-"], secret_group_name=SECRET_GROUP),
+        _r("gitlab-runner-token", CategoryGitLab, "GitLab runner registration token", S.HIGH,
+           ws(r"GR1348941[0-9a-zA-Z_\-]{20}"), ["GR1348941"], secret_group_name=SECRET_GROUP),
+        _r("gitlab-pipeline-trigger-token", CategoryGitLab, "GitLab pipeline trigger token", S.HIGH,
+           ws(r"glptt-[0-9a-f]{40}"), ["glptt-"], secret_group_name=SECRET_GROUP),
+        # ----- key material ----------------------------------------------------
+        _r("private-key", CategoryAsymmetricPrivateKey, "Asymmetric private key block", S.HIGH,
+           r"-----BEGIN (?:RSA |EC |DSA |OPENSSH |PGP |ENCRYPTED )?PRIVATE KEY(?: BLOCK)?-----"
+           r"(?P<secret>[\s\S]*?)-----END",
+           ["-----BEGIN"], secret_group_name=SECRET_GROUP),
+        _r("age-secret-key", "Age", "age encryption secret key", S.MEDIUM,
+           ws(r"AGE-SECRET-KEY-1[0-9A-Z]{58}"), ["AGE-SECRET-KEY-1"],
+           secret_group_name=SECRET_GROUP),
+        _r("jwt-token", CategoryGeneric, "JSON Web Token", S.MEDIUM,
+           ws(r"ey[a-zA-Z0-9_=]{14,}\.ey[a-zA-Z0-9_/+\-=]{14,}\.[a-zA-Z0-9_/+\-=]{10,}"),
+           ["eyJ"], secret_group_name=SECRET_GROUP),
+        # ----- chat / collaboration -------------------------------------------
+        _r("slack-bot-token", CategorySlack, "Slack bot token", S.HIGH,
+           ws(r"xoxb-[0-9]{8,14}-[0-9]{8,14}-[0-9a-zA-Z]{18,32}"), ["xoxb-"],
+           secret_group_name=SECRET_GROUP),
+        _r("slack-user-token", CategorySlack, "Slack user token", S.HIGH,
+           ws(r"xox[ps]-[0-9]{8,14}-[0-9]{8,14}-[0-9]{8,14}-[0-9a-f]{28,34}"),
+           ["xoxp-", "xoxs-"], secret_group_name=SECRET_GROUP),
+        _r("slack-app-token", CategorySlack, "Slack app-level token", S.HIGH,
+           ws(r"xapp-[0-9]-[0-9A-Z]{8,12}-[0-9]{10,14}-[0-9a-f]{60,70}"), ["xapp-"],
+           secret_group_name=SECRET_GROUP),
+        _r("slack-webhook-url", CategorySlack, "Slack incoming webhook URL", S.MEDIUM,
+           r"https://hooks\.slack\.com/(?:services|workflows)/"
+           r"[0-9A-Z]{8,12}/[0-9A-Z]{8,12}/[0-9a-zA-Z]{20,26}",
+           ["hooks.slack.com"]),
+        _r("discord-bot-token", "Discord", "Discord bot token", S.HIGH,
+           r"(?i)discord[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
+           r"(?P<secret>[MNO][a-zA-Z0-9_\-]{23,25}\.[a-zA-Z0-9_\-]{6}\.[a-zA-Z0-9_\-]{27,38})",
+           ["discord"], secret_group_name=SECRET_GROUP),
+        _r("telegram-bot-token", "Telegram", "Telegram bot token", S.HIGH,
+           r"(?i)telegram[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
+           r"(?P<secret>[0-9]{8,10}:[0-9A-Za-z_\-]{35})",
+           ["telegram"], secret_group_name=SECRET_GROUP),
+        # ----- payments --------------------------------------------------------
+        _r("stripe-secret-key", CategoryStripe, "Stripe secret key", S.CRITICAL,
+           ws(r"sk_(?:test|live)_[0-9a-zA-Z]{24,99}"), ["sk_test_", "sk_live_"],
+           secret_group_name=SECRET_GROUP),
+        _r("stripe-publishable-key", CategoryStripe, "Stripe publishable key", S.LOW,
+           ws(r"pk_(?:test|live)_[0-9a-zA-Z]{24,99}"), ["pk_test_", "pk_live_"],
+           secret_group_name=SECRET_GROUP),
+        _r("square-access-token", "Square", "Square access token", S.HIGH,
+           ws(r"sq0atp-[0-9A-Za-z_\-]{22}"), ["sq0atp-"], secret_group_name=SECRET_GROUP),
+        _r("square-oauth-secret", "Square", "Square OAuth secret", S.HIGH,
+           ws(r"sq0csp-[0-9A-Za-z_\-]{43}"), ["sq0csp-"], secret_group_name=SECRET_GROUP),
+        _r("paypal-braintree-token", "PayPal", "Braintree access token", S.HIGH,
+           ws(r"access_token\$production\$[0-9a-z]{16}\$[0-9a-f]{32}"),
+           ["access_token$production$"], secret_group_name=SECRET_GROUP),
+        _r("shopify-access-token", CategoryShopify, "Shopify access token", S.CRITICAL,
+           ws(r"shpat_[0-9a-fA-F]{32}"), ["shpat_"], secret_group_name=SECRET_GROUP),
+        _r("shopify-custom-app-token", CategoryShopify, "Shopify custom app access token", S.CRITICAL,
+           ws(r"shpca_[0-9a-fA-F]{32}"), ["shpca_"], secret_group_name=SECRET_GROUP),
+        _r("shopify-private-app-token", CategoryShopify, "Shopify private app access token",
+           S.CRITICAL, ws(r"shppa_[0-9a-fA-F]{32}"), ["shppa_"], secret_group_name=SECRET_GROUP),
+        _r("shopify-shared-secret", CategoryShopify, "Shopify shared secret", S.HIGH,
+           ws(r"shpss_[0-9a-fA-F]{32}"), ["shpss_"], secret_group_name=SECRET_GROUP),
+        # ----- email / messaging SaaS -----------------------------------------
+        _r("sendgrid-api-key", "SendGrid", "SendGrid API key", S.HIGH,
+           ws(r"SG\.[0-9A-Za-z_\-]{22}\.[0-9A-Za-z_\-]{43}"), ["SG."],
+           secret_group_name=SECRET_GROUP),
+        _r("mailgun-api-key", "Mailgun", "Mailgun API key", S.HIGH,
+           ws(r"key-[0-9a-f]{32}"), ["key-"], secret_group_name=SECRET_GROUP),
+        _r("mailchimp-api-key", "Mailchimp", "Mailchimp API key", S.HIGH,
+           ws(r"[0-9a-f]{32}-us[0-9]{1,2}"), ["-us"], secret_group_name=SECRET_GROUP),
+        _r("twilio-api-key", "Twilio", "Twilio API key SID", S.HIGH,
+           r"(?i)twilio[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}(?P<secret>SK[0-9a-f]{32})",
+           ["twilio"], secret_group_name=SECRET_GROUP),
+        # ----- package registries ---------------------------------------------
+        _r("npm-access-token", "npm", "npm access token", S.CRITICAL,
+           ws(r"npm_[0-9a-zA-Z]{36}"), ["npm_"], secret_group_name=SECRET_GROUP),
+        _r("pypi-upload-token", "PyPI", "PyPI upload token", S.HIGH,
+           r"pypi-AgEIcHlwaS5vcmc[0-9A-Za-z_\-]{50,1000}", ["pypi-AgEIcHlwaS5vcmc"]),
+        _r("rubygems-api-key", "RubyGems", "RubyGems API key", S.HIGH,
+           ws(r"rubygems_[0-9a-f]{48}"), ["rubygems_"], secret_group_name=SECRET_GROUP),
+        _r("clojars-deploy-token", "Clojars", "Clojars deploy token", S.HIGH,
+           r"CLOJARS_[0-9a-z]{60}", ["CLOJARS_"]),
+        # ----- CI / infra SaaS -------------------------------------------------
+        _r("databricks-token", "Databricks", "Databricks API token", S.HIGH,
+           ws(r"dapi[0-9a-h]{32}"), ["dapi"], secret_group_name=SECRET_GROUP),
+        _r("hashicorp-tf-api-token", "HashiCorp", "Terraform Cloud / Vault API token", S.HIGH,
+           ws(r"[0-9a-zA-Z]{14}\.atlasv1\.[0-9a-zA-Z_\-]{60,70}"), [".atlasv1."],
+           secret_group_name=SECRET_GROUP),
+        _r("dockerhub-pat", "Docker", "Docker Hub personal access token", S.HIGH,
+           ws(r"dckr_pat_[0-9a-zA-Z_\-]{27}"), ["dckr_pat_"], secret_group_name=SECRET_GROUP),
+        _r("grafana-api-token", "Grafana", "Grafana API token", S.MEDIUM,
+           ws(r"eyJrIjoi[0-9a-zA-Z_=\-]{60,100}"), ["eyJrIjoi"], secret_group_name=SECRET_GROUP),
+        _r("grafana-service-account-token", "Grafana", "Grafana service account token", S.MEDIUM,
+           ws(r"glsa_[0-9a-zA-Z_]{32}_[0-9a-f]{8}"), ["glsa_"], secret_group_name=SECRET_GROUP),
+        _r("newrelic-user-api-key", "NewRelic", "New Relic user API key", S.MEDIUM,
+           ws(r"NRAK-[0-9A-Z]{27}"), ["NRAK-"], secret_group_name=SECRET_GROUP),
+        _r("datadog-access-token", "Datadog", "Datadog access token", S.MEDIUM,
+           r"(?i)datadog[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}(?P<secret>[0-9a-f]{40})",
+           ["datadog"], secret_group_name=SECRET_GROUP),
+        _r("pulumi-api-token", "Pulumi", "Pulumi API token", S.HIGH,
+           ws(r"pul-[0-9a-f]{40}"), ["pul-"], secret_group_name=SECRET_GROUP),
+        _r("doppler-api-token", "Doppler", "Doppler API token", S.HIGH,
+           ws(r"dp\.pt\.[0-9a-zA-Z]{43}"), ["dp.pt."], secret_group_name=SECRET_GROUP),
+        _r("flyio-access-token", "Fly.io", "Fly.io access token", S.HIGH,
+           ws(r"fo1_[0-9a-zA-Z_\-]{43}"), ["fo1_"], secret_group_name=SECRET_GROUP),
+        # ----- AI / data SaaS --------------------------------------------------
+        _r("openai-api-key", "OpenAI", "OpenAI API key", S.HIGH,
+           ws(r"sk-[0-9a-zA-Z]{20}T3BlbkFJ[0-9a-zA-Z]{20}"), ["T3BlbkFJ"],
+           secret_group_name=SECRET_GROUP),
+        _r("huggingface-access-token", "HuggingFace", "Hugging Face access token", S.HIGH,
+           ws(r"hf_[a-zA-Z]{34}"), ["hf_"], secret_group_name=SECRET_GROUP),
+        _r("anthropic-api-key", "Anthropic", "Anthropic API key", S.HIGH,
+           ws(r"sk-ant-[a-zA-Z0-9_\-]{20,120}"), ["sk-ant-"], secret_group_name=SECRET_GROUP),
+        # ----- misc SaaS -------------------------------------------------------
+        _r("atlassian-api-token", "Atlassian", "Atlassian API token", S.HIGH,
+           r"(?i)(?:atlassian|jira|confluence)[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
+           r"(?P<secret>[a-zA-Z0-9]{24})(?:[^a-zA-Z0-9]|$)",
+           ["atlassian", "jira", "confluence"], secret_group_name=SECRET_GROUP),
+        _r("asana-access-token", "Asana", "Asana personal access token", S.MEDIUM,
+           r"(?i)asana[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
+           r"(?P<secret>[0-9]/[0-9]{10,16}:[0-9a-f]{32})",
+           ["asana"], secret_group_name=SECRET_GROUP),
+        _r("dropbox-short-lived-token", "Dropbox", "Dropbox short-lived access token", S.MEDIUM,
+           ws(r"sl\.[0-9a-zA-Z_\-]{130,152}"), ["sl."], secret_group_name=SECRET_GROUP),
+        _r("netlify-access-token", "Netlify", "Netlify access token", S.MEDIUM,
+           r"(?i)netlify[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
+           r"(?P<secret>[0-9a-zA-Z_\-]{40,46})",
+           ["netlify"], secret_group_name=SECRET_GROUP),
+        _r("linear-api-key", "Linear", "Linear API key", S.MEDIUM,
+           ws(r"lin_api_[0-9a-zA-Z]{40}"), ["lin_api_"], secret_group_name=SECRET_GROUP),
+        _r("postman-api-token", "Postman", "Postman API token", S.MEDIUM,
+           ws(r"PMAK-[0-9a-f]{24}-[0-9a-f]{34}"), ["PMAK-"], secret_group_name=SECRET_GROUP),
+        _r("sentry-access-token", "Sentry", "Sentry auth token", S.MEDIUM,
+           r"(?i)sentry[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}(?P<secret>[0-9a-f]{64})",
+           ["sentry"], secret_group_name=SECRET_GROUP),
+        _r("facebook-access-token", "Facebook", "Facebook access token", S.HIGH,
+           ws(r"EAACEdEose0cBA[0-9A-Za-z]+"), ["EAACEdEose0cBA"], secret_group_name=SECRET_GROUP),
+        _r("twitter-bearer-token", "Twitter", "Twitter/X bearer token", S.MEDIUM,
+           ws(r"AAAAAAAAAAAAAAAAAAAAA[0-9a-zA-Z%]{60,120}"), ["AAAAAAAAAAAAAAAAAAAAA"],
+           secret_group_name=SECRET_GROUP),
+        # ----- generic fallbacks ----------------------------------------------
+        _r("basic-auth-url", CategoryGeneric, "Credentials embedded in URL", S.HIGH,
+           r"[a-zA-Z][a-zA-Z0-9+.\-]{1,9}://[^/\s:@\"']{1,64}:(?P<secret>[^/\s:@\"']{3,64})@"
+           r"[0-9a-zA-Z\-_.]{1,128}",
+           ["://"], secret_group_name=SECRET_GROUP,
+           allow_rules=[
+               AllowRule(id="url-placeholder-password",
+                         description="templated / placeholder credentials",
+                         regex=r"^(?:\$|%s|%v|\{\{|<|\[)"),
+           ]),
+        _r("generic-api-key", CategoryGeneric, "Generic API key assignment", S.MEDIUM,
+           r"(?i)(?:api[_\-]?key|apikey|secret[_\-]?key|auth[_\-]?token|access[_\-]?token)"
+           r"[a-z0-9_\-\s\"']{0,10}[=:][\s\"']{0,5}"
+           r"(?P<secret>[0-9a-zA-Z_\-]{20,64})(?:[\"'\s]|$)",
+           ["api_key", "apikey", "api-key", "secret_key", "secret-key",
+            "auth_token", "auth-token", "access_token", "access-token"],
+           secret_group_name=SECRET_GROUP,
+           allow_rules=[
+               AllowRule(id="generic-placeholder",
+                         description="placeholder values (matched against the extracted secret)",
+                         regex=r"(?i)^(?:x{8,}|\*{8,}|(?:your|my|the|an?|some|this|change|replace|dummy|fake|test|example|sample|placeholder|insert)[_\-]?[a-z_\-]*|[0-9a-zA-Z_\-]*(?:example|sample|placeholder|changeme|xxxxx)[0-9a-zA-Z_\-]*)$"),
+           ]),
+    ]
+    return rules
+
+
+def builtin_allow_rules() -> list[AllowRule]:
+    """Global path allowlist (ref: pkg/fanal/secret/builtin-allow-rules.go:3-65):
+    test/example/vendored/system trees where findings are overwhelmingly noise."""
+    return [
+        AllowRule(id="tests", description="test fixtures",
+                  path=r"(?:^|/)(?:tests?|testing|testdata|spec|specs)/"),
+        AllowRule(id="examples", description="example code",
+                  path=r"(?:^|/)examples?/"),
+        AllowRule(id="vendor", description="vendored dependencies",
+                  path=r"(?:^|/)(?:vendor|third_party|thirdparty|node_modules)/"),
+        AllowRule(id="usr-dirs", description="system binary/library trees",
+                  path=r"^usr/(?:share|include|lib)/"),
+        AllowRule(id="locale-dir", description="locale data",
+                  path=r"(?:^|/)locale/"),
+        AllowRule(id="markdown", description="documentation",
+                  path=r"\.(?:md|markdown|rst)$"),
+        AllowRule(id="golang-dir", description="go module cache",
+                  path=r"(?:^|/)go/pkg/mod/"),
+        AllowRule(id="python-dist", description="python runtime/dist dirs",
+                  path=r"(?:^|/)(?:site-packages|dist-packages|\.venv|venv)/"),
+        AllowRule(id="ruby-gems", description="installed ruby gems",
+                  path=r"(?:^|/)gems/[^/]+/(?:lib|spec|test)/"),
+        AllowRule(id="wordpress-core", description="wordpress core", path=r"(?:^|/)wp-includes/"),
+        AllowRule(id="anaconda-dir", description="conda packages", path=r"(?:^|/)pkgs/[^/]+/info/"),
+        AllowRule(id="minified-js", description="minified/bundled javascript",
+                  path=r"\.(?:min\.js|js\.map)$"),
+    ]
