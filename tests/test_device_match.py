@@ -1,0 +1,132 @@
+"""Differential tests: device matcher vs the exact CPU engine.
+
+Device contract (see trivy_tpu.secret.device_compile): for every (file, rule)
+pair where the exact engine finds at least one location, the device must flag
+that rule in at least one chunk covering the file — NO false negatives.
+False positives are allowed (host confirm removes them).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from tests.secret_samples import SAMPLES
+from trivy_tpu.ops.match import build_match_fn
+from trivy_tpu.secret.device_compile import compile_rules
+from trivy_tpu.secret.engine import SecretScanner
+from trivy_tpu.secret.rules import builtin_rules
+
+CHUNK = 4096
+
+
+@pytest.fixture(scope="module")
+def scanner():
+    return SecretScanner()
+
+
+@pytest.fixture(scope="module")
+def compiled():
+    return compile_rules(builtin_rules())
+
+
+@pytest.fixture(scope="module")
+def match_fn(compiled):
+    return build_match_fn(compiled, CHUNK)
+
+
+def chunkify(data: bytes, chunk: int = CHUNK, overlap: int = 256) -> np.ndarray:
+    """Split into overlapping fixed-size chunks, zero-padded."""
+    step = chunk - overlap
+    starts = list(range(0, max(1, len(data)), step))
+    # drop trailing chunks fully covered by the previous one
+    starts = [s for i, s in enumerate(starts) if i == 0 or s < len(data)]
+    out = np.zeros((len(starts), chunk), dtype=np.uint8)
+    for i, s in enumerate(starts):
+        piece = data[s : s + chunk]
+        out[i, : len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+    return out
+
+
+def device_rule_hits(match_fn, compiled, data: bytes) -> set[str]:
+    chunks = chunkify(data)
+    hits = np.asarray(match_fn(chunks))  # [B, R]
+    flagged = hits.any(axis=0)
+    ids = {compiled.rule_ids[i] for i in np.nonzero(flagged)[0]}
+    ids.update(compiled.host_rule_ids)
+    return ids
+
+
+def cpu_rule_hits(scanner: SecretScanner, data: bytes) -> set[str]:
+    secret = scanner.scan_bytes("src/config.txt", data)
+    return {f.rule_id for f in secret.findings}
+
+
+@pytest.mark.parametrize("rule_id", sorted(SAMPLES))
+def test_sample_matches_cpu_engine(scanner, rule_id):
+    """Ground truth sanity: each sample is found by the exact engine."""
+    data = f"some text\n{SAMPLES[rule_id]}\nmore text\n".encode()
+    found = cpu_rule_hits(scanner, data)
+    assert rule_id in found, f"CPU engine missed sample for {rule_id}: {found}"
+
+
+@pytest.mark.parametrize("rule_id", sorted(SAMPLES))
+def test_device_flags_sample(scanner, compiled, match_fn, rule_id):
+    """No-FN: every CPU-detected rule is flagged by the device."""
+    data = f"some text\n{SAMPLES[rule_id]}\nmore text\n".encode()
+    cpu = cpu_rule_hits(scanner, data)
+    dev = device_rule_hits(match_fn, compiled, data)
+    assert cpu <= dev, f"device missed {cpu - dev}"
+
+
+def test_device_no_fn_at_chunk_boundaries(scanner, compiled, match_fn):
+    """Secrets straddling chunk steps must still be flagged via overlap."""
+    sample = SAMPLES["github-pat"]
+    step = CHUNK - 256
+    for pos in [step - 60, step - 20, step - 1, step, step + 10, 2 * step - 30]:
+        data = (b"x" * pos + b"\n" + sample.encode() + b"\n" + b"y" * 200)
+        cpu = cpu_rule_hits(scanner, data)
+        assert "github-pat" in cpu
+        dev = device_rule_hits(match_fn, compiled, data)
+        assert cpu <= dev, f"pos={pos}: device missed {cpu - dev}"
+
+
+def test_device_no_fn_fuzz(scanner, compiled, match_fn):
+    """Randomized corpus: CPU rule set is always a subset of device flags."""
+    rng = random.Random(1234)
+    ids = sorted(SAMPLES)
+    for trial in range(20):
+        parts = []
+        for _ in range(rng.randint(0, 200)):
+            parts.append(
+                "".join(
+                    rng.choice(
+                        "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                        "0123456789 \t=:\"'{}[]()/._-$%"
+                    )
+                    for _ in range(rng.randint(0, 80))
+                )
+            )
+        for _ in range(rng.randint(0, 4)):
+            parts.insert(rng.randint(0, len(parts)), SAMPLES[rng.choice(ids)])
+        data = "\n".join(parts).encode()
+        cpu = cpu_rule_hits(scanner, data)
+        dev = device_rule_hits(match_fn, compiled, data)
+        assert cpu <= dev, f"trial={trial}: device missed {cpu - dev}"
+
+
+def test_device_precision_on_anchored_rules(compiled, match_fn):
+    """Anchored rules verify their device window: near-miss tokens (broken
+    class runs) must NOT be flagged, keeping host-confirm traffic low."""
+    near_misses = [
+        "ghp_tooshort",                     # run shorter than 36
+        "dop_v1_" + "g" * 64,               # 'g' not in [a-f0-9]
+        "AKIA" + "lower" + "X" * 11,        # lowercase not in [0-9A-Z]
+    ]
+    data = ("\n".join(near_misses) + "\n").encode()
+    chunks = chunkify(data)
+    hits = np.asarray(match_fn(chunks)).any(axis=0)
+    flagged = {compiled.rule_ids[i] for i in np.nonzero(hits)[0]}
+    assert "github-pat" not in flagged
+    assert "digitalocean-pat" not in flagged
+    assert "aws-access-key-id" not in flagged

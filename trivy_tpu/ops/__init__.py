@@ -1,0 +1,1 @@
+"""Device kernels (JAX/XLA, Pallas where it pays) for the scan engines."""

@@ -1,0 +1,386 @@
+"""Compile secret rules into device-executable match programs.
+
+The TPU secret path is a two-stage design (the reference itself stages a
+keyword prefilter before the regex, ref: pkg/fanal/secret/scanner.go:174-186,
+377-463): the device evaluates every rule against every chunk and returns a
+per-(chunk, rule) *hit boolean*; the host then runs the exact regex engine
+(`SecretScanner`) on just the flagged (file, rule) pairs. Device hits may
+contain false positives (they only cost a cheap host confirmation) but must
+never contain false negatives — that invariant is what makes the final
+findings byte-identical to the CPU backend.
+
+Each rule compiles into one of three lanes:
+
+- **anchored lane**: the regex lowers to one or more *variants*, each an
+  anchor literal (>= 3 bytes at a fixed offset from the match start) plus
+  character-class window checks at fixed offsets. Constructs that won't
+  lower (lookarounds, backrefs, optional/variable mid-pattern runs, anchors)
+  are *truncated*: dropping a required suffix condition only weakens the
+  predicate, which can only add false positives — soundness is preserved.
+- **keyword lane**: rules that don't lower use their keyword prefilter
+  (lowercased substring search, exactly the reference's `MatchKeywords`
+  semantics) on device.
+- **host lane**: rules with neither an anchored program nor keywords are
+  evaluated host-side on every file (the reference also regex-scans every
+  file for keyword-less rules).
+
+The compiled output is a set of flat tables consumed by
+`trivy_tpu.ops.match.build_match_fn`.
+"""
+
+from __future__ import annotations
+
+import re
+import re._constants as sre_c
+import re._parser as sre_parse
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu.secret.rules import Rule
+
+# Minimum anchor literal length: shorter literals are too common to be useful
+# hash anchors and would flood the host-confirm stage.
+MIN_ANCHOR = 3
+# Cap on variants per rule (branch fan-out) before falling back to keywords.
+MAX_VARIANTS = 48
+# Cap on expanding fixed repeats into per-byte classes.
+MAX_EXPAND = 64
+
+_ALL_BYTES = frozenset(range(256))
+_NL = ord("\n")
+_DIGITS = frozenset(range(48, 58))
+_WORD = _DIGITS | frozenset(range(65, 91)) | frozenset(range(97, 123)) | {95}
+_SPACES = frozenset(b" \t\n\r\x0b\x0c")
+_ALNUM = _DIGITS | frozenset(range(65, 91)) | frozenset(range(97, 123))
+
+
+class _Truncate(Exception):
+    """Lowering stopped at an un-lowerable construct; tokens accumulated so
+    far (mutated in place) remain valid as a weaker predicate."""
+
+
+@dataclass(frozen=True)
+class Token:
+    """A run of ``count`` mandatory chars drawn from ``chars``."""
+
+    chars: frozenset
+    count: int
+
+
+@dataclass
+class Check:
+    """Window check: positions [anchor+delta, anchor+delta+count) all in class."""
+
+    chars: frozenset
+    count: int
+    delta: int  # offset from the anchor's first byte (may be negative)
+    class_id: int = -1
+
+
+@dataclass
+class Variant:
+    anchor: bytes
+    checks: list[Check] = field(default_factory=list)
+    pre_len: int = 0  # fixed bytes between match start and anchor start
+    boundary: bool = False  # require non-alnum (or pos 0) before match start
+
+    @property
+    def window(self) -> tuple[int, int]:
+        """[lo, hi) byte range the program inspects, relative to the anchor."""
+        lo = min(
+            [0]
+            + [c.delta for c in self.checks]
+            + ([-self.pre_len - 1] if self.boundary else [])
+        )
+        hi = max([len(self.anchor)] + [c.delta + c.count for c in self.checks])
+        return lo, hi
+
+
+@dataclass
+class CompiledRules:
+    """Device tables for one effective ruleset.
+
+    ``rule_ids`` indexes the output axis of the match kernel; a hit for rule
+    ``i`` means "run exact rule ``rule_ids[i]`` on this file host-side".
+    ``host_rule_ids`` must be evaluated host-side on every file.
+    """
+
+    rule_ids: list[str]
+    classes: np.ndarray  # [n_classes, 256] bool
+    variants: list[tuple[int, Variant]]  # (rule_index, variant)
+    keywords: list[tuple[int, bytes]]  # (rule_index, lowercased keyword)
+    host_rule_ids: list[str]
+    margin: int  # max bytes a program inspects beyond/behind a position
+    span: int = 8  # required chunk overlap (max device-window extent)
+    anchored_rule_ids: list[str] = field(default_factory=list)
+
+    @property
+    def num_rules(self) -> int:
+        return len(self.rule_ids)
+
+
+def _category_chars(cat) -> frozenset:
+    if cat == sre_c.CATEGORY_DIGIT:
+        return _DIGITS
+    if cat == sre_c.CATEGORY_NOT_DIGIT:
+        return _ALL_BYTES - _DIGITS
+    if cat == sre_c.CATEGORY_WORD:
+        return _WORD
+    if cat == sre_c.CATEGORY_NOT_WORD:
+        return _ALL_BYTES - _WORD
+    if cat == sre_c.CATEGORY_SPACE:
+        return _SPACES
+    if cat == sre_c.CATEGORY_NOT_SPACE:
+        return _ALL_BYTES - _SPACES
+    raise _Truncate
+
+
+def _in_chars(items) -> frozenset:
+    negate = False
+    chars: set[int] = set()
+    for op, av in items:
+        if op == sre_c.NEGATE:
+            negate = True
+        elif op == sre_c.LITERAL:
+            if av < 256:
+                chars.add(av)
+        elif op == sre_c.RANGE:
+            lo, hi = av
+            chars.update(range(lo, min(hi, 255) + 1))
+        elif op == sre_c.CATEGORY:
+            chars.update(_category_chars(av))
+        else:
+            raise _Truncate
+    return frozenset(_ALL_BYTES - chars) if negate else frozenset(chars)
+
+
+def _single_chars(op, av) -> frozenset:
+    """Character set of a single-position node."""
+    if op == sre_c.LITERAL:
+        if av >= 256:
+            raise _Truncate
+        return frozenset({av})
+    if op == sre_c.NOT_LITERAL:
+        return _ALL_BYTES - {av}
+    if op == sre_c.IN:
+        return _in_chars(av)
+    if op == sre_c.ANY:
+        return _ALL_BYTES - {_NL}
+    raise _Truncate
+
+
+def _is_word_prefix_branch(op, av) -> frozenset | None:
+    """Detect the leading ``(?:^|[^...])`` word-boundary idiom
+    (ref: builtin-rules.go:81 startWord) and return its boundary class."""
+    if op != sre_c.BRANCH:
+        return None
+    _, alts = av
+    if len(alts) != 2:
+        return None
+    for a, b in ((list(alts[0]), list(alts[1])), (list(alts[1]), list(alts[0]))):
+        if len(a) == 1 and a[0][0] == sre_c.AT and len(b) == 1:
+            try:
+                return _single_chars(*b[0])
+            except _Truncate:
+                return None
+    return None
+
+
+def _walk(nodes, streams: list[list[Token]]) -> None:
+    """Lower an AST node sequence onto every open token stream, mutating
+    ``streams`` in place so partial progress survives :class:`_Truncate`.
+    """
+    for op, av in nodes:
+        if op in (sre_c.LITERAL, sre_c.NOT_LITERAL, sre_c.IN, sre_c.ANY):
+            tok = Token(_single_chars(op, av), 1)
+            for s in streams:
+                s.append(tok)
+        elif op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
+            lo, hi, sub = av
+            sub = list(sub)
+            if len(sub) == 1 and sub[0][0] in (
+                sre_c.LITERAL,
+                sre_c.NOT_LITERAL,
+                sre_c.IN,
+                sre_c.ANY,
+            ):
+                chars = _single_chars(*sub[0])
+                if lo > 0:
+                    for s in streams:
+                        s.append(Token(chars, lo))
+                if hi != lo:
+                    # variable run: offsets beyond it are unknown
+                    raise _Truncate
+            else:
+                if lo == 0:
+                    raise _Truncate
+                if lo * max(1, len(sub)) > MAX_EXPAND:
+                    # check the first mandatory copy, then stop
+                    _walk(sub, streams)
+                    raise _Truncate
+                for _ in range(lo):
+                    _walk(sub, streams)
+                if hi != lo:
+                    raise _Truncate
+        elif op == sre_c.SUBPATTERN:
+            _g, add_f, _del_f, sub = av
+            if add_f & re.IGNORECASE:
+                raise _Truncate
+            _walk(list(sub), streams)
+        elif op == sre_c.BRANCH:
+            _, alts = av
+            if len(streams) * len(alts) > MAX_VARIANTS:
+                raise _Truncate
+            forked: list[list[Token]] = []
+            truncated = False
+            for alt in alts:
+                alt_streams = [list(s) for s in streams]
+                try:
+                    _walk(list(alt), alt_streams)
+                except _Truncate:
+                    truncated = True
+                forked.extend(alt_streams)
+            streams[:] = forked
+            if truncated:
+                raise _Truncate
+        else:
+            # AT, ASSERT, ASSERT_NOT, GROUPREF, ...: cannot lower
+            raise _Truncate
+
+
+def _compile_variant(tokens: list[Token], boundary: bool) -> Variant | None:
+    # expand fixed tokens into per-byte classes (long runs keep run form)
+    seq: list[frozenset] = []
+    tail_runs: list[Token] = []  # runs too long to expand, kept as checks
+    for t in tokens:
+        if t.count > MAX_EXPAND:
+            tail_runs.append(t)
+            break  # positions after it are known, but keep it simple
+        seq.extend([t.chars] * t.count)
+
+    # anchor = longest run of singleton classes
+    best: tuple[int, int] | None = None
+    i = 0
+    while i < len(seq):
+        if len(seq[i]) == 1:
+            j = i
+            while j < len(seq) and len(seq[j]) == 1:
+                j += 1
+            if best is None or (j - i) > best[1]:
+                best = (i, j - i)
+            i = j
+        else:
+            i += 1
+    if best is None or best[1] < MIN_ANCHOR:
+        return None
+    a_start, a_len = best
+    anchor = bytes(next(iter(seq[k])) for k in range(a_start, a_start + a_len))
+    v = Variant(anchor=anchor, pre_len=a_start, boundary=boundary)
+
+    checks: list[Check] = []
+    k = 0
+    while k < len(seq):
+        if a_start <= k < a_start + a_len:
+            k += 1
+            continue
+        chars = seq[k]
+        j = k
+        while j < len(seq) and not (a_start <= j < a_start + a_len) and seq[j] == chars:
+            j += 1
+        if chars != _ALL_BYTES:
+            checks.append(Check(chars=chars, count=j - k, delta=k - a_start))
+        k = j
+    for t in tail_runs:
+        if t.chars != _ALL_BYTES:
+            checks.append(Check(chars=t.chars, count=t.count, delta=len(seq) - a_start))
+    v.checks = checks
+    return v
+
+
+def compile_rule(rule: Rule) -> list[Variant] | None:
+    """Lower one rule to anchored variants, or None for keyword/host lane."""
+    try:
+        tree = sre_parse.parse(rule.regex)
+    except Exception:
+        return None
+    if tree.state.flags & re.IGNORECASE:
+        return None
+    nodes = list(tree)
+    boundary = False
+    if nodes:
+        bc = _is_word_prefix_branch(*nodes[0])
+        if bc is not None:
+            # only the standard non-alnum boundary is modeled; any other
+            # boundary class is skipped (sound: weaker predicate)
+            boundary = bc == (_ALL_BYTES - _ALNUM)
+            nodes = nodes[1:]
+    streams: list[list[Token]] = [[]]
+    try:
+        _walk(nodes, streams)
+    except _Truncate:
+        pass
+    variants = []
+    for s in streams:
+        v = _compile_variant(s, boundary)
+        if v is None:
+            return None  # every variant must be detectable, else no-FN breaks
+        variants.append(v)
+    return variants or None
+
+
+def compile_rules(rules: list[Rule]) -> CompiledRules:
+    """Compile an effective ruleset to device tables."""
+    rule_ids: list[str] = []
+    variants: list[tuple[int, Variant]] = []
+    keywords: list[tuple[int, bytes]] = []
+    host_rule_ids: list[str] = []
+    anchored_rule_ids: list[str] = []
+    class_index: dict[frozenset, int] = {}
+
+    for rule in rules:
+        prog = compile_rule(rule)
+        if prog is not None:
+            ridx = len(rule_ids)
+            rule_ids.append(rule.id)
+            anchored_rule_ids.append(rule.id)
+            for v in prog:
+                for c in v.checks:
+                    if c.chars not in class_index:
+                        class_index[c.chars] = len(class_index)
+                    c.class_id = class_index[c.chars]
+                variants.append((ridx, v))
+        elif rule.lower_keywords:
+            ridx = len(rule_ids)
+            rule_ids.append(rule.id)
+            for kw in rule.lower_keywords:
+                keywords.append((ridx, kw.encode("latin-1")))
+        else:
+            host_rule_ids.append(rule.id)
+
+    classes = np.zeros((max(1, len(class_index)), 256), dtype=bool)
+    for chars, idx in class_index.items():
+        classes[idx, list(chars)] = True
+
+    # margin: array padding for shifted reads; span: required chunk overlap
+    # so every device window lies fully inside at least one chunk's real data
+    margin = 8
+    span = 8
+    for _, v in variants:
+        lo, hi = v.window
+        margin = max(margin, hi, -lo)
+        span = max(span, hi - lo)
+    for _, kw in keywords:
+        margin = max(margin, len(kw))
+        span = max(span, len(kw))
+
+    return CompiledRules(
+        rule_ids=rule_ids,
+        classes=classes,
+        variants=variants,
+        keywords=keywords,
+        host_rule_ids=host_rule_ids,
+        margin=margin,
+        span=span,
+        anchored_rule_ids=anchored_rule_ids,
+    )
