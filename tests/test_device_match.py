@@ -39,8 +39,6 @@ def chunkify(data: bytes, chunk: int = CHUNK, overlap: int = 256) -> np.ndarray:
     """Split into overlapping fixed-size chunks, zero-padded."""
     step = chunk - overlap
     starts = list(range(0, max(1, len(data)), step))
-    # drop trailing chunks fully covered by the previous one
-    starts = [s for i, s in enumerate(starts) if i == 0 or s < len(data)]
     out = np.zeros((len(starts), chunk), dtype=np.uint8)
     for i, s in enumerate(starts):
         piece = data[s : s + chunk]

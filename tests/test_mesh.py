@@ -1,0 +1,50 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh (conftest)."""
+
+import jax
+import numpy as np
+import pytest
+
+from tests.secret_samples import SAMPLES
+from trivy_tpu.ops.match import build_match_fn
+from trivy_tpu.parallel.mesh import get_mesh, hit_counts_psum, pad_batch
+from trivy_tpu.secret.device_compile import compile_rules
+from trivy_tpu.secret.engine import SecretScanner
+from trivy_tpu.secret.rules import builtin_rules
+from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+
+def test_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_scan_parity():
+    mesh = get_mesh(8)
+    cpu = SecretScanner()
+    tpu = TpuSecretScanner(chunk_len=1024, batch_size=16, mesh=mesh)
+    files = [
+        (f"f{i}.txt", f"head\n{text}\ntail\n".encode())
+        for i, (rid, text) in enumerate(sorted(SAMPLES.items())[:10])
+    ]
+    for (path, data), secret in zip(files, tpu.scan_files(files)):
+        want = cpu.scan_bytes(path, data)
+        assert secret.to_dict() == want.to_dict()
+
+
+def test_mesh_2d_shapes():
+    mesh = get_mesh(8, model=2)
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+
+def test_hit_counts_psum():
+    compiled = compile_rules(builtin_rules())
+    mesh = get_mesh(8)
+    fn = build_match_fn(compiled, 1024)
+    counts_fn = hit_counts_psum(fn, mesh)
+    sample = SAMPLES["github-pat"].encode()
+    chunk = np.zeros(1024, dtype=np.uint8)
+    chunk[: len(sample)] = np.frombuffer(sample, dtype=np.uint8)
+    batch = np.stack([chunk] * 3 + [np.zeros(1024, dtype=np.uint8)] * 5)
+    counts = np.asarray(counts_fn(pad_batch(batch, 8)))
+    ridx = compiled.rule_ids.index("github-pat")
+    assert counts[ridx] == 3
+    assert counts.sum() == 3

@@ -1,0 +1,55 @@
+"""Pallas kernel equivalence vs the XLA kernel.
+
+On the CPU test mesh the pallas TPU kernel can't lower natively, so a tiny
+case runs in interpret mode; on real TPU hardware (bench/driver runs) the
+full differential suite exercises it via TpuSecretScanner(backend='pallas').
+"""
+
+import numpy as np
+import pytest
+
+from tests.secret_samples import SAMPLES
+from trivy_tpu.secret.device_compile import compile_rules
+from trivy_tpu.secret.rules import builtin_rules
+
+
+def test_group_packing_covers_all_variants():
+    from trivy_tpu.ops.match_pallas import GROUP_MASK_BUDGET, _group_variants
+
+    compiled = compile_rules(builtin_rules())
+    groups = _group_variants(compiled.variants, GROUP_MASK_BUDGET)
+    flat = [id(v) for g in groups for _, v in g]
+    assert len(flat) == len(compiled.variants)
+    assert set(flat) == {id(v) for _, v in compiled.variants}
+
+
+@pytest.mark.slow
+def test_pallas_interpret_matches_xla():
+    # interpret mode is slow: one small batch only
+    import jax.experimental.pallas as pl  # noqa: F401
+    from unittest import mock
+
+    from trivy_tpu.ops import match_pallas
+    from trivy_tpu.ops.match import build_match_fn
+
+    compiled = compile_rules(builtin_rules())
+    CL = 1024
+    orig = pl.pallas_call
+
+    def interp(*a, **kw):
+        kw["interpret"] = True
+        return orig(*a, **kw)
+
+    with mock.patch.object(match_pallas.pl, "pallas_call", interp):
+        fp = match_pallas.build_match_fn_pallas(compiled, CL)
+        rows = []
+        for s in sorted(SAMPLES.values())[:8]:
+            row = np.zeros(CL, dtype=np.uint8)
+            enc = f"x {s} y".encode("latin-1")[:CL]
+            row[: len(enc)] = np.frombuffer(enc, dtype=np.uint8)
+            rows.append(row)
+        batch = np.stack(rows)
+        hp = np.asarray(fp(batch))
+    fx = build_match_fn(compiled, CL)
+    hx = np.asarray(fx(batch))
+    assert np.array_equal(hp, hx)

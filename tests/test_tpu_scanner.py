@@ -1,0 +1,155 @@
+"""End-to-end parity: TPU-backed scanner vs exact CPU engine.
+
+The north-star property (ref: BASELINE.md): findings byte-identical to the
+CPU backend, including line numbers, censoring, context windows, sort order.
+Verified via to_dict() equality on every file of a mixed corpus.
+"""
+
+import random
+
+import pytest
+
+from tests.secret_samples import SAMPLES
+from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
+from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+
+@pytest.fixture(scope="module")
+def cpu():
+    return SecretScanner()
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    # small chunks force multi-chunk files and boundary handling
+    return TpuSecretScanner(chunk_len=2048, batch_size=8)
+
+
+def assert_parity(cpu, tpu, files):
+    got = list(tpu.scan_files(files))
+    assert len(got) == len(files)
+    for (path, data), secret in zip(files, got):
+        want = cpu.scan_bytes(path, data)
+        assert secret.to_dict() == want.to_dict(), f"mismatch for {path}"
+
+
+def test_parity_per_rule_samples(cpu, tpu):
+    files = [
+        (f"src/cfg_{rid}.txt", f"line one\n{text}\nline three\n".encode())
+        for rid, text in sorted(SAMPLES.items())
+    ]
+    assert_parity(cpu, tpu, files)
+
+
+def test_parity_multichunk_files(cpu, tpu):
+    rng = random.Random(7)
+    files = []
+    ids = sorted(SAMPLES)
+    for i in range(6):
+        lines = []
+        for _ in range(rng.randint(50, 400)):
+            lines.append("x" * rng.randint(0, 120))
+            if rng.random() < 0.08:
+                lines.append(SAMPLES[rng.choice(ids)])
+        files.append((f"big/file_{i}.conf", "\n".join(lines).encode()))
+    assert_parity(cpu, tpu, files)
+
+
+def test_parity_empty_and_clean_files(cpu, tpu):
+    files = [
+        ("empty.txt", b""),
+        ("clean.txt", b"nothing secret here\njust text\n"),
+        ("binaryish.bin", bytes(range(256)) * 8),
+    ]
+    assert_parity(cpu, tpu, files)
+
+
+def test_allow_path_skips_device_work(cpu, tpu):
+    files = [
+        ("vendor/lib/creds.txt", f"{SAMPLES['github-pat']}\n".encode()),
+        ("testdata/creds.txt", f"{SAMPLES['github-pat']}\n".encode()),
+        ("src/creds.txt", f"{SAMPLES['github-pat']}\n".encode()),
+    ]
+    got = list(tpu.scan_files(files))
+    assert not got[0].findings and not got[1].findings
+    assert got[2].findings
+    assert_parity(cpu, tpu, files)
+
+
+def test_parity_with_custom_rules():
+    cfg = ScannerConfig.from_dict(
+        {
+            "rules": [
+                {
+                    "id": "company-token",
+                    "category": "Company",
+                    "title": "Company internal token",
+                    "severity": "HIGH",
+                    "regex": r"cmp_[0-9a-f]{16}",
+                    "keywords": ["cmp_"],
+                },
+            ],
+            "disable-rules": ["mailgun-api-key"],
+        }
+    )
+    cpu = SecretScanner(cfg)
+    tpu = TpuSecretScanner(cfg, chunk_len=1024, batch_size=4)
+    files = [
+        ("a.txt", b"token cmp_0123456789abcdef end\n"),
+        ("b.txt", b"key-f8a9b0c1d2e3f4a5b6c7d8e9f0a1b2c3\n"),  # disabled rule
+        ("c.txt", f"{SAMPLES['github-pat']}\n".encode()),
+    ]
+    assert_parity(cpu, tpu, files)
+    got = list(tpu.scan_files(files))
+    assert got[0].findings[0].rule_id == "company-token"
+    assert not got[1].findings
+
+
+def test_secret_at_exact_chunk_boundaries(cpu, tpu):
+    sample = SAMPLES["slack-bot-token"]
+    step = tpu.chunk_len - tpu.overlap
+    files = []
+    for pos in [step - len(sample), step - 10, step - 1, step, step + 1, 2 * step - 5]:
+        data = b"a" * pos + b"\n" + sample.encode() + b"\nrest\n"
+        files.append((f"bound_{pos}.txt", data))
+    assert_parity(cpu, tpu, files)
+    for s in tpu.scan_files(files):
+        assert any(f.rule_id == "slack-bot-token" for f in s.findings), s.file_path
+
+
+def test_parity_latin1_space_and_dotall_custom_rules():
+    """Regression: \\s must cover latin-1 unicode whitespace (\\xa0) and
+    (?s) must make '.' match newlines on device — both were FNs."""
+    cfg = ScannerConfig.from_dict(
+        {
+            "rules": [
+                {
+                    "id": "nbsp-rule",
+                    "regex": r"SECRETKEY\s[0-9a-f]{32}",
+                    "keywords": [],
+                    "severity": "HIGH",
+                },
+                {
+                    "id": "dotall-rule",
+                    "regex": r"(?s)KEYSTART.[0-9a-f]{8}",
+                    "keywords": [],
+                    "severity": "HIGH",
+                },
+            ]
+        }
+    )
+    cpu = SecretScanner(cfg)
+    tpu = TpuSecretScanner(cfg, chunk_len=1024, batch_size=4)
+    files = [
+        ("nbsp.txt", b"SECRETKEY\xa0" + b"f" * 32 + b"\n"),
+        ("dotall.txt", b"KEYSTART\n" + b"abcdef01" + b"\n"),
+    ]
+    assert_parity(cpu, tpu, files)
+    got = list(tpu.scan_files(files))
+    assert got[0].findings and got[0].findings[0].rule_id == "nbsp-rule"
+    assert got[1].findings and got[1].findings[0].rule_id == "dotall-rule"
+
+
+def test_chunk_len_too_small_raises():
+    with pytest.raises(ValueError):
+        TpuSecretScanner(chunk_len=128, batch_size=4)

@@ -2,23 +2,27 @@
 
 Replaces the reference's per-file, per-rule Go-regexp loop (ref:
 pkg/fanal/secret/scanner.go:377-463, the north-star hot loop) with one
-data-parallel pass over a batch of fixed-size byte chunks:
+data-parallel pass over a batch of fixed-size byte chunks. All device work is
+elementwise boolean/int8 ops over ``[B, C]`` arrays with static shifted
+slices — the shape the TPU VPU executes at HBM bandwidth. Three building
+blocks, chosen specifically to avoid TPU-hostile patterns (int32 multiplies,
+long-axis cumsums, small gathers):
 
-- **Anchor matching** uses a polynomial rolling hash: one prefix-sum over the
-  chunk gives every window hash in O(1) further work per distinct window
-  length (``h_w[p] = (P[p+w] - P[p]) * r^-p`` in the 2^32 ring, where the odd
-  base ``r`` is invertible). Hash collisions only add false positives, which
-  the host confirm stage removes — the device contract is *no false
-  negatives*, see `trivy_tpu.secret.device_compile`.
-- **Character-class window checks** use per-class cumulative sums: "the n
-  bytes at offset d are all in class c" is one shifted subtract-and-compare.
-- **Word-boundary checks** read one byte before the match start (zero
-  padding makes out-of-range reads permissive — false positives only).
+- **Anchor/keyword literals**: the first 4 bytes of every literal compare as
+  one packed uint32 word (built once with shifts/ors); remaining bytes are
+  shifted byte-equality ANDs. No hashing, no multiplies.
+- **Character classes**: compiled to interval lists at build time; class
+  membership is a handful of range compares. No table gathers.
+- **Window checks** ("n consecutive bytes all in class"): sparse-table
+  doubling — ``D[k][p] = all-in-class over [p, p+2^k)`` built by
+  ``D[k+1][p] = D[k][p] & D[k][p+2^k]``; an arbitrary-length window is the
+  AND of two overlapping power-of-two windows. O(log n) passes, no cumsum.
 
-Everything is elementwise/cumsum over a ``[B, C]`` uint8 batch: no
-data-dependent control flow, static shapes, HBM-bandwidth-bound — the shape
-XLA compiles well to the TPU VPU. The returned function is jittable and maps
-over a device mesh by sharding the batch axis (see trivy_tpu.parallel).
+Device contract (see trivy_tpu.secret.device_compile): per-(chunk, rule) hit
+booleans with possible false positives and NO false negatives; the host
+confirm stage re-runs the exact engine on flagged (file, rule) pairs only.
+The returned function is jittable and shards over a device mesh along the
+batch axis (see trivy_tpu.parallel).
 """
 
 from __future__ import annotations
@@ -31,30 +35,26 @@ import numpy as np
 
 from trivy_tpu.secret.device_compile import CompiledRules
 
-# Odd multiplier => invertible mod 2^32 (FNV prime).
-_HASH_BASE = 0x01000193
-_HASH_BASE_INV = pow(_HASH_BASE, -1, 1 << 32)
+_ALNUM_INTERVALS = [(48, 57), (65, 90), (97, 122)]
 
 
-def _powers(base: int, n: int) -> np.ndarray:
-    out = np.empty(n, dtype=np.uint32)
-    acc = 1
-    for i in range(n):
-        out[i] = acc
-        acc = (acc * base) & 0xFFFFFFFF
+def _intervals(chars: frozenset) -> list[tuple[int, int]]:
+    """Sorted byte set -> minimal closed intervals."""
+    out: list[tuple[int, int]] = []
+    for b in sorted(chars):
+        if out and b == out[-1][1] + 1:
+            out[-1] = (out[-1][0], b)
+        else:
+            out.append((b, b))
     return out
 
 
-def _literal_hash(lit: bytes) -> int:
-    h = 0
-    for j, b in enumerate(lit):
-        h = (h + b * pow(_HASH_BASE, j, 1 << 32)) & 0xFFFFFFFF
-    return h
-
-
-_ALNUM_TABLE = np.zeros(256, dtype=bool)
-for _c in b"0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz":
-    _ALNUM_TABLE[_c] = True
+def _word32(lit: bytes) -> int:
+    """First 4 bytes little-endian packed."""
+    w = 0
+    for i in range(4):
+        w |= lit[i] << (8 * i)
+    return w
 
 
 def build_match_fn(compiled: CompiledRules, chunk_len: int):
@@ -65,73 +65,129 @@ def build_match_fn(compiled: CompiledRules, chunk_len: int):
     verified; for keyword rules a keyword substring is present).
     """
     C = chunk_len
-    M = max(8, compiled.margin + 1)
-    L = C + 2 * M  # padded length; position p of the chunk sits at index M+p
-
-    rpow = jnp.asarray(_powers(_HASH_BASE, L), dtype=jnp.uint32)
-    rinvpow = jnp.asarray(_powers(_HASH_BASE_INV, L), dtype=jnp.uint32)[M : M + C]
-    classes = jnp.asarray(compiled.classes)
-    alnum = jnp.asarray(_ALNUM_TABLE)
-
-    anchor_lengths = sorted({len(v.anchor) for _, v in compiled.variants})
-    keyword_lengths = sorted({len(kw) for _, kw in compiled.keywords})
-    class_ids = sorted({c.class_id for _, v in compiled.variants for c in v.checks})
+    M = max(8, compiled.margin + 4)
     num_rules = compiled.num_rules
 
+    # class interval tables (compile-time)
+    n_classes = compiled.classes.shape[0]
+    class_intervals = []
+    for cid in range(n_classes):
+        chars = frozenset(np.nonzero(compiled.classes[cid])[0].tolist())
+        # complement form when it is cheaper (e.g. [^x] classes)
+        inv = _intervals(frozenset(range(256)) - chars)
+        pos = _intervals(chars)
+        if len(inv) < len(pos):
+            class_intervals.append(("neg", inv))
+        else:
+            class_intervals.append(("pos", pos))
+
+    # which doubling levels each class needs: {(cid, k)}
+    need_levels: dict[int, int] = {}
+    for _, v in compiled.variants:
+        for ch in v.checks:
+            if ch.count >= 2:
+                k = (ch.count).bit_length() - 1
+                need_levels[ch.class_id] = max(need_levels.get(ch.class_id, 0), k)
+
     def fn(chunks: jax.Array) -> jax.Array:
+        x = jnp.pad(chunks, ((0, 0), (M, M)))  # [B, L] uint8, zeros
         B = chunks.shape[0]
-        x = jnp.pad(chunks, ((0, 0), (M, M)))  # [B, L] uint8, zero-filled
-        xi = x.astype(jnp.int32)
 
-        def window_hashes(data_u32, lengths):
-            """h[w][b, p] = rolling hash of data[p : p+w] for p in [0, C)."""
-            prefix = jnp.cumsum(data_u32 * rpow[None, :], axis=1, dtype=jnp.uint32)
-            prefix = jnp.pad(prefix, ((0, 0), (1, 0)))  # P[i] = sum_{k<i}
-            base = jax.lax.slice_in_dim(prefix, M, M + C, axis=1)
-            out = {}
-            for w in lengths:
-                hi = jax.lax.slice_in_dim(prefix, M + w, M + w + C, axis=1)
-                out[w] = (hi - base) * rinvpow[None, :]
-            return out
+        def shift(arr: jax.Array, d: int) -> jax.Array:
+            """arr[:, M+d : M+d+C] — value at chunk position p+d."""
+            return jax.lax.slice_in_dim(arr, M + d, M + d + C, axis=1)
 
-        h_raw = window_hashes(x.astype(jnp.uint32), anchor_lengths)
-
-        # lowercased copy for keyword matching (reference lowercases content,
-        # ref: scanner.go:174-186)
+        # packed 4-byte words for literal compares (little-endian)
+        xw = x.astype(jnp.uint32)
+        word = (
+            xw
+            + jnp.pad(xw[:, 1:], ((0, 0), (0, 1))) * jnp.uint32(1 << 8)
+            + jnp.pad(xw[:, 2:], ((0, 0), (0, 2))) * jnp.uint32(1 << 16)
+            + jnp.pad(xw[:, 3:], ((0, 0), (0, 3))) * jnp.uint32(1 << 24)
+        )
         is_upper = (x >= 65) & (x <= 90)
         xl = jnp.where(is_upper, x + 32, x)
-        h_low = window_hashes(xl.astype(jnp.uint32), keyword_lengths)
+        xlw = xl.astype(jnp.uint32)
+        word_l = (
+            xlw
+            + jnp.pad(xlw[:, 1:], ((0, 0), (0, 1))) * jnp.uint32(1 << 8)
+            + jnp.pad(xlw[:, 2:], ((0, 0), (0, 2))) * jnp.uint32(1 << 16)
+            + jnp.pad(xlw[:, 3:], ((0, 0), (0, 3))) * jnp.uint32(1 << 24)
+        )
 
-        # per-class cumulative sums for window checks
-        cls_cumsum = {}
-        for cid in class_ids:
-            inc = jnp.take(classes[cid], xi, axis=0).astype(jnp.int32)  # [B, L]
-            cs = jnp.pad(jnp.cumsum(inc, axis=1), ((0, 0), (1, 0)))
-            cls_cumsum[cid] = cs
+        def literal_hit(lit: bytes, data: jax.Array, wdata: jax.Array) -> jax.Array:
+            """[B, C] bool: literal starts at position p."""
+            if len(lit) >= 4:
+                ok = shift(wdata, 0) == jnp.uint32(_word32(lit))
+                for j in range(4, len(lit)):
+                    ok &= shift(data, j) == lit[j]
+            else:
+                ok = shift(data, 0) == lit[0]
+                for j in range(1, len(lit)):
+                    ok &= shift(data, j) == lit[j]
+            return ok
+
+        def in_class(cid: int, data: jax.Array) -> jax.Array:
+            kind, ivs = class_intervals[cid]
+            m = jnp.zeros(data.shape, dtype=bool)
+            for lo, hi in ivs:
+                if lo == hi:
+                    m |= data == lo
+                else:
+                    m |= (data >= lo) & (data <= hi)
+            return ~m if kind == "neg" else m
+
+        # doubling tables: dtab[cid][k][B, L] = all-in-class over [p, p+2^k)
+        dtab: dict[int, list[jax.Array]] = {}
+        for cid in sorted(need_levels):
+            base = in_class(cid, x)
+            levels = [base]
+            for k in range(need_levels[cid]):
+                w = 1 << k
+                prev = levels[-1]
+                nxt = prev & jnp.pad(prev[:, w:], ((0, 0), (0, w)))
+                levels.append(nxt)
+            dtab[cid] = levels
+        cls0: dict[int, jax.Array] = {}  # single-byte class membership
+
+        def class_base(cid: int) -> jax.Array:
+            if cid in dtab:
+                return dtab[cid][0]
+            if cid not in cls0:
+                cls0[cid] = in_class(cid, x)
+            return cls0[cid]
 
         def window_ok(cid: int, n: int, delta: int) -> jax.Array:
-            cs = cls_cumsum[cid]
-            a = jax.lax.slice_in_dim(cs, M + delta + n, M + delta + n + C, axis=1)
-            b = jax.lax.slice_in_dim(cs, M + delta, M + delta + C, axis=1)
-            return (a - b) == n
+            """[B, C] bool at anchor positions p: bytes [p+delta, p+delta+n)
+            all in class cid."""
+            if n == 1:
+                return shift(class_base(cid), delta)
+            k = n.bit_length() - 1
+            lv = dtab[cid][k]
+            w = 1 << k
+            hit = shift(lv, delta)
+            if n != w:
+                hit &= shift(lv, delta + n - w)
+            return hit
 
-        # non-alnum lookup for boundary checks (padding zeros are non-alnum,
-        # so chunk-start / file-start positions pass — permissive, FP-only)
-        non_alnum = ~jnp.take(alnum, xi, axis=0)  # [B, L]
+        # non-alnum membership for word-boundary checks (padding zeros are
+        # non-alnum, so chunk-start / file-start positions pass — FP-only)
+        na = jnp.ones(x.shape, dtype=bool)
+        for lo, hi in _ALNUM_INTERVALS:
+            na &= ~((x >= lo) & (x <= hi))
 
         per_rule: list[list[jax.Array]] = [[] for _ in range(num_rules)]
 
         for ridx, v in compiled.variants:
-            ok = h_raw[len(v.anchor)] == jnp.uint32(_literal_hash(v.anchor))
+            ok = literal_hit(v.anchor, x, word)
             for ch in v.checks:
                 ok &= window_ok(ch.class_id, ch.count, ch.delta)
             if v.boundary:
-                d = -v.pre_len - 1
-                ok &= jax.lax.slice_in_dim(non_alnum, M + d, M + d + C, axis=1)
+                ok &= shift(na, -v.pre_len - 1)
             per_rule[ridx].append(ok.any(axis=1))
 
         for ridx, kw in compiled.keywords:
-            ok = h_low[len(kw)] == jnp.uint32(_literal_hash(kw))
+            ok = literal_hit(kw, xl, word_l)
             per_rule[ridx].append(ok.any(axis=1))
 
         cols = [

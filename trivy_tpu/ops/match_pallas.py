@@ -1,0 +1,214 @@
+"""Pallas TPU kernel for the batched secret matcher.
+
+Same device contract as `trivy_tpu.ops.match.build_match_fn` (per-(chunk,
+rule) hit booleans, no false negatives), but fused into VMEM-resident passes:
+the XLA version materializes hundreds of [B, C] intermediates in HBM (≈30×
+traffic amplification); here masks live in VMEM and HBM sees each byte a
+handful of times.
+
+Layout: chunks are *self-contained* rows (the host chunker's overlap already
+guarantees every match window lies fully inside some chunk), so the grid is
+1-D over row blocks — no halo exchange. Shifted reads at row edges see zeros,
+exactly like the XLA version's padding: permissive for boundary checks
+(FP-only) and failing for class windows (covered by the overlap guarantee).
+
+VMEM discipline: a single fused kernel would keep every class mask and
+doubling level alive at once (~55 MB — over the 16 MB scoped limit), so
+variants are packed into *groups* whose working set fits VMEM; each group is
+its own pallas_call over the same input and the per-rule partials OR together
+in XLA. Re-reading the input per group costs only G× HBM input traffic,
+negligible next to the VPU work.
+
+Mosaic constraints honored here: vector arithmetic is i32/i16 only (bytes
+widen on entry), and i1 vectors can't be stored/concatenated (all masks are
+int32 0/1 planes combined with bitwise ops).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trivy_tpu.ops.match import _ALNUM_INTERVALS, _intervals
+from trivy_tpu.secret.device_compile import CompiledRules, Variant
+
+BLOCK_ROWS = 8  # i32 sublane tile
+# masks per group: (masks + overhead) * BLOCK_ROWS*C*4 bytes must fit VMEM
+GROUP_MASK_BUDGET = 24
+
+
+def _class_intervals(compiled: CompiledRules):
+    out = []
+    for cid in range(compiled.classes.shape[0]):
+        chars = frozenset(np.nonzero(compiled.classes[cid])[0].tolist())
+        inv = _intervals(frozenset(range(256)) - chars)
+        pos = _intervals(chars)
+        out.append(("neg", inv) if len(inv) < len(pos) else ("pos", pos))
+    return out
+
+
+def _variant_masks(v: Variant) -> set:
+    """Distinct (class, doubling-level) masks this variant's checks need."""
+    need = set()
+    for ch in v.checks:
+        if ch.count == 1:
+            need.add((ch.class_id, 0))
+        else:
+            k = ch.count.bit_length() - 1
+            need.update((ch.class_id, j) for j in range(k + 1))
+    return need
+
+
+def _group_variants(variants, budget: int):
+    """Greedily pack variants into groups with bounded mask working sets,
+    after sorting by class signature so related rules share masks."""
+    order = sorted(
+        range(len(variants)),
+        key=lambda i: tuple(sorted(_variant_masks(variants[i][1]))),
+    )
+    groups: list[tuple[list, set]] = []
+    for i in order:
+        ridx_v = variants[i]
+        need = _variant_masks(ridx_v[1])
+        placed = False
+        for g, gmask in groups:
+            if len(gmask | need) <= budget:
+                g.append(ridx_v)
+                gmask |= need
+                placed = True
+                break
+        if not placed:
+            groups.append(([ridx_v], set(need)))
+    return [g for g, _ in groups]
+
+
+def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
+    """chunks [B, chunk_len] uint8 -> [B, R] bool. B must be a multiple of
+    BLOCK_ROWS (use trivy_tpu.parallel.pad_batch); chunk_len a multiple
+    of 128."""
+    C = chunk_len
+    if C % 128:
+        raise ValueError("chunk_len must be a multiple of 128")
+    R = compiled.num_rules
+    class_intervals = _class_intervals(compiled)
+    var_groups = _group_variants(compiled.variants, GROUP_MASK_BUDGET)
+
+    def make_kernel(group, with_keywords: bool):
+        def kernel(x_ref, out_ref):
+            x = x_ref[:].astype(jnp.int32)  # [TB, C]
+
+            def b(pred):
+                return pred.astype(jnp.int32)
+
+            def shift(arr, d):
+                if d == 0:
+                    return arr
+                z = jnp.zeros_like(arr[:, : abs(d)])
+                if d > 0:
+                    return jnp.concatenate([arr[:, d:], z], axis=1)
+                return jnp.concatenate([z, arr[:, :d]], axis=1)
+
+            def literal_hit(lit: bytes, data):
+                ok = b(data == lit[0])
+                for j in range(1, len(lit)):
+                    ok &= b(shift(data, j) == lit[j])
+                return ok
+
+            def in_class(cid):
+                kind, ivs = class_intervals[cid]
+                m = None
+                for lo, hi in ivs:
+                    t = b(x == lo) if lo == hi else b(x >= lo) & b(x <= hi)
+                    m = t if m is None else (m | t)
+                if m is None:
+                    m = jnp.zeros(x.shape, dtype=jnp.int32)
+                return 1 - m if kind == "neg" else m
+
+            cache: dict[tuple[int, int], jax.Array] = {}
+
+            def level(cid, k):
+                if (cid, k) not in cache:
+                    if k == 0:
+                        cache[(cid, k)] = in_class(cid)
+                    else:
+                        prev = level(cid, k - 1)
+                        cache[(cid, k)] = prev & shift(prev, 1 << (k - 1))
+                return cache[(cid, k)]
+
+            def window_ok(cid, n, delta):
+                if n == 1:
+                    return shift(level(cid, 0), delta)
+                k = n.bit_length() - 1
+                lv = level(cid, k)
+                hit = shift(lv, delta)
+                if n != (1 << k):
+                    hit &= shift(lv, delta + n - (1 << k))
+                return hit
+
+            na = None
+            per_rule: dict[int, jax.Array] = {}
+
+            for ridx, v in group:
+                ok = literal_hit(v.anchor, x)
+                for ch in v.checks:
+                    ok &= window_ok(ch.class_id, ch.count, ch.delta)
+                if v.boundary:
+                    if na is None:
+                        a = None
+                        for lo, hi in _ALNUM_INTERVALS:
+                            t = b(x >= lo) & b(x <= hi)
+                            a = t if a is None else (a | t)
+                        na = 1 - a
+                    ok &= shift(na, -v.pre_len - 1)
+                col = jnp.max(ok, axis=1, keepdims=True)
+                per_rule[ridx] = (
+                    jnp.maximum(per_rule[ridx], col) if ridx in per_rule else col
+                )
+
+            if with_keywords:
+                xl = jnp.where((x >= 65) & (x <= 90), x + 32, x)
+                for ridx, kw in compiled.keywords:
+                    ok = literal_hit(kw, xl)
+                    col = jnp.max(ok, axis=1, keepdims=True)
+                    per_rule[ridx] = (
+                        jnp.maximum(per_rule[ridx], col) if ridx in per_rule else col
+                    )
+
+            zero = jnp.zeros((x.shape[0], 1), dtype=jnp.int32)
+            cols = [per_rule.get(r, zero) for r in range(R)]
+            out_ref[:] = jnp.concatenate(cols, axis=1)
+
+        return kernel
+
+    kernels = [make_kernel(g, False) for g in var_groups]
+    kernels.append(make_kernel([], True))  # keyword group
+
+    @jax.jit
+    def fn(chunks: jax.Array) -> jax.Array:
+        B = chunks.shape[0]
+        assert B % BLOCK_ROWS == 0, f"batch {B} not a multiple of {BLOCK_ROWS}"
+        partials = []
+        for kern in kernels:
+            partials.append(
+                pl.pallas_call(
+                    kern,
+                    out_shape=jax.ShapeDtypeStruct((B, R), jnp.int32),
+                    grid=(B // BLOCK_ROWS,),
+                    in_specs=[
+                        pl.BlockSpec(
+                            (BLOCK_ROWS, C), lambda i: (i, 0), memory_space=pltpu.VMEM
+                        )
+                    ],
+                    out_specs=pl.BlockSpec(
+                        (BLOCK_ROWS, R), lambda i: (i, 0), memory_space=pltpu.VMEM
+                    ),
+                )(chunks)
+            )
+        return functools.reduce(jnp.maximum, partials).astype(bool)
+
+    return fn

@@ -1,0 +1,82 @@
+"""Device mesh construction and sharded kernel wrappers.
+
+Design (scaling-book recipe): pick a mesh, annotate shardings, let XLA insert
+the collectives. Scan workloads here are data-parallel over the chunk-batch
+axis — chunks shard across 'data', rule tables are tiny and replicated;
+reductions (per-rule hit counts for telemetry) psum over 'data'. The 'model'
+axis exists for kernels with a large table dimension (license n-gram corpus
+shards, advisory-DB shards) that shard their lookup tables.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def get_mesh(n_devices: int | None = None, model: int = 1) -> Mesh:
+    """A ('data', 'model') mesh over the available (or first n) devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % model != 0:
+        raise ValueError(f"{n} devices not divisible by model={model}")
+    arr = np.array(devs).reshape(n // model, model)
+    return Mesh(arr, ("data", "model"))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Chunk batches: leading batch axis over 'data', bytes replicated."""
+    return NamedSharding(mesh, P("data", None))
+
+
+def pad_batch(chunks: np.ndarray, multiple: int) -> np.ndarray:
+    """Zero-pad the batch axis up to a multiple (padding chunks are all-zero
+    bytes: no literal hashes to zero, so they produce no hits)."""
+    b = chunks.shape[0]
+    rem = (-b) % multiple
+    if rem == 0:
+        return chunks
+    return np.concatenate([chunks, np.zeros((rem,) + chunks.shape[1:], chunks.dtype)])
+
+
+def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
+    """Shard a match kernel's batch axis over the mesh 'data' axis.
+
+    Uses shard_map so the kernel (XLA graph or pallas_call) runs as-is on
+    each device's local shard with zero communication; only the
+    caller-visible output gather rides ICI. Batch size must be padded to a
+    multiple of data_parallelism * rows_multiple (see :func:`pad_batch`).
+    """
+    fn = jax.jit(
+        jax.shard_map(
+            match_fn, mesh=mesh, in_specs=(P("data", None),), out_specs=P("data", None)
+        )
+    )
+
+    def run(chunks: np.ndarray) -> jax.Array:
+        return fn(jnp.asarray(chunks))
+
+    run.data_parallelism = int(mesh.shape["data"]) * rows_multiple
+    return run
+
+
+def hit_counts_psum(match_fn, mesh: Mesh):
+    """Per-rule global hit counts over a sharded batch, reduced with psum
+    over ICI — the telemetry/all-gather path exercised by dryrun_multichip."""
+    def step(chunks):  # local shard [B/d, C]
+        hits = match_fn(chunks)  # [B/d, R] bool
+        local = jnp.sum(hits.astype(jnp.int32), axis=0)  # [R]
+        return jax.lax.psum(local, axis_name="data")
+
+    return jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P("data", None),),
+            out_specs=P(),
+        )
+    )

@@ -49,10 +49,14 @@ MAX_EXPAND = 64
 
 _ALL_BYTES = frozenset(range(256))
 _NL = ord("\n")
-_DIGITS = frozenset(range(48, 58))
-_WORD = _DIGITS | frozenset(range(65, 91)) | frozenset(range(97, 123)) | {95}
-_SPACES = frozenset(b" \t\n\r\x0b\x0c")
-_ALNUM = _DIGITS | frozenset(range(65, 91)) | frozenset(range(97, 123))
+# Content is scanned as latin-1 text (1:1 byte<->char), so \d/\w/\s must use
+# Python's *unicode* semantics restricted to the first 256 codepoints — the
+# ASCII-only sets would silently drop matches like \xa0 for \s (a device
+# false negative, breaking the no-FN contract for custom rules).
+_DIGITS = frozenset(b for b in range(256) if re.match(r"\d", chr(b)))
+_WORD = frozenset(b for b in range(256) if re.match(r"\w", chr(b)))
+_SPACES = frozenset(b for b in range(256) if re.match(r"\s", chr(b)))
+_ALNUM = frozenset(range(48, 58)) | frozenset(range(65, 91)) | frozenset(range(97, 123))
 
 
 class _Truncate(Exception):
@@ -155,7 +159,7 @@ def _in_chars(items) -> frozenset:
     return frozenset(_ALL_BYTES - chars) if negate else frozenset(chars)
 
 
-def _single_chars(op, av) -> frozenset:
+def _single_chars(op, av, dotall: bool = False) -> frozenset:
     """Character set of a single-position node."""
     if op == sre_c.LITERAL:
         if av >= 256:
@@ -166,7 +170,7 @@ def _single_chars(op, av) -> frozenset:
     if op == sre_c.IN:
         return _in_chars(av)
     if op == sre_c.ANY:
-        return _ALL_BYTES - {_NL}
+        return _ALL_BYTES if dotall else _ALL_BYTES - {_NL}
     raise _Truncate
 
 
@@ -187,13 +191,13 @@ def _is_word_prefix_branch(op, av) -> frozenset | None:
     return None
 
 
-def _walk(nodes, streams: list[list[Token]]) -> None:
+def _walk(nodes, streams: list[list[Token]], dotall: bool = False) -> None:
     """Lower an AST node sequence onto every open token stream, mutating
     ``streams`` in place so partial progress survives :class:`_Truncate`.
     """
     for op, av in nodes:
         if op in (sre_c.LITERAL, sre_c.NOT_LITERAL, sre_c.IN, sre_c.ANY):
-            tok = Token(_single_chars(op, av), 1)
+            tok = Token(_single_chars(op, av, dotall), 1)
             for s in streams:
                 s.append(tok)
         elif op in (sre_c.MAX_REPEAT, sre_c.MIN_REPEAT):
@@ -205,7 +209,7 @@ def _walk(nodes, streams: list[list[Token]]) -> None:
                 sre_c.IN,
                 sre_c.ANY,
             ):
-                chars = _single_chars(*sub[0])
+                chars = _single_chars(*sub[0], dotall)
                 if lo > 0:
                     for s in streams:
                         s.append(Token(chars, lo))
@@ -217,17 +221,17 @@ def _walk(nodes, streams: list[list[Token]]) -> None:
                     raise _Truncate
                 if lo * max(1, len(sub)) > MAX_EXPAND:
                     # check the first mandatory copy, then stop
-                    _walk(sub, streams)
+                    _walk(sub, streams, dotall)
                     raise _Truncate
                 for _ in range(lo):
-                    _walk(sub, streams)
+                    _walk(sub, streams, dotall)
                 if hi != lo:
                     raise _Truncate
         elif op == sre_c.SUBPATTERN:
             _g, add_f, _del_f, sub = av
             if add_f & re.IGNORECASE:
                 raise _Truncate
-            _walk(list(sub), streams)
+            _walk(list(sub), streams, dotall or bool(add_f & re.DOTALL))
         elif op == sre_c.BRANCH:
             _, alts = av
             if len(streams) * len(alts) > MAX_VARIANTS:
@@ -237,7 +241,7 @@ def _walk(nodes, streams: list[list[Token]]) -> None:
             for alt in alts:
                 alt_streams = [list(s) for s in streams]
                 try:
-                    _walk(list(alt), alt_streams)
+                    _walk(list(alt), alt_streams, dotall)
                 except _Truncate:
                     truncated = True
                 forked.extend(alt_streams)
@@ -317,7 +321,7 @@ def compile_rule(rule: Rule) -> list[Variant] | None:
             nodes = nodes[1:]
     streams: list[list[Token]] = [[]]
     try:
-        _walk(nodes, streams)
+        _walk(nodes, streams, dotall=bool(tree.state.flags & re.DOTALL))
     except _Truncate:
         pass
     variants = []

@@ -1,0 +1,208 @@
+"""TPU-backed secret scanner: device prefilter + exact host confirmation.
+
+Pipeline (replaces the reference's walk→goroutine→regexp chain, ref:
+pkg/fanal/secret/scanner.go:377 and SURVEY.md §3.2):
+
+  files → overlapping fixed-size chunks → [B, C] batches → device match
+  kernel → per-(file, rule) candidates → exact `SecretScanner` restricted to
+  candidate rules → findings (byte-identical to the CPU backend).
+
+Chunk overlap equals the compiled ruleset's maximum device window, so every
+device-checkable window lies fully inside at least one chunk — matches
+longer than the window (e.g. private-key bodies) only need their *anchor
+window* contained; the host confirm then runs over the whole file.
+
+Batches are dispatched asynchronously (JAX dispatch is async by default) with
+a depth-1 pipeline: the host packs batch N+1 while the device matches batch
+N — the TPU analog of the reference's `parallel.Pipeline` feeder/worker
+split (ref: pkg/parallel/pipeline.go:14-115).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterable, Iterator
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from trivy_tpu import log
+from trivy_tpu.ops.match import build_match_fn
+from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
+from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
+from trivy_tpu.types import Secret
+
+logger = log.logger("secret:tpu")
+
+DEFAULT_CHUNK_LEN = 65536
+DEFAULT_BATCH = 64
+# pallas path: small self-contained rows, large batches (32 MB per dispatch)
+PALLAS_CHUNK_LEN = 8192
+PALLAS_BATCH = 4096
+
+
+def chunk_spans(n: int, chunk_len: int, overlap: int) -> list[int]:
+    """Chunk start offsets covering ``n`` bytes with the given overlap."""
+    if n <= chunk_len:
+        return [0]
+    step = chunk_len - overlap
+    starts = list(range(0, n - overlap, step))
+    return starts
+
+
+@dataclass
+class _FileState:
+    path: str
+    data: bytes
+    pending: int  # chunks not yet matched
+    rules: set[int] = field(default_factory=set)  # candidate rule indices
+
+
+class TpuSecretScanner:
+    """Drop-in equivalent of :class:`SecretScanner` batched over TPU.
+
+    ``scan_files`` consumes an iterable of (path, bytes) and yields one
+    :class:`Secret` per input file, in input order, with findings identical
+    to ``SecretScanner.scan_bytes``.
+    """
+
+    def __init__(
+        self,
+        config: ScannerConfig | None = None,
+        chunk_len: int | None = None,
+        batch_size: int | None = None,
+        mesh=None,
+        backend: str = "auto",
+    ):
+        import jax
+
+        self.exact = SecretScanner(config)
+        self.compiled: CompiledRules = compile_rules(self.exact.rules)
+        if backend == "auto":
+            platform = jax.devices()[0].platform
+            backend = "pallas" if platform not in ("cpu", "METAL") else "xla"
+        self.backend = backend
+        if backend == "pallas":
+            from trivy_tpu.ops.match_pallas import BLOCK_ROWS, build_match_fn_pallas
+
+            self.chunk_len = chunk_len or PALLAS_CHUNK_LEN
+            self.batch_size = batch_size or PALLAS_BATCH
+            rows_mult = BLOCK_ROWS
+            match_fn = build_match_fn_pallas(self.compiled, self.chunk_len)
+        else:
+            self.chunk_len = chunk_len or DEFAULT_CHUNK_LEN
+            self.batch_size = batch_size or DEFAULT_BATCH
+            rows_mult = 1
+            match_fn = build_match_fn(self.compiled, self.chunk_len)
+        self.overlap = max(64, self.compiled.span + 1)
+        if self.overlap > self.chunk_len // 2:
+            raise ValueError(
+                f"chunk_len={self.chunk_len} too small for ruleset: the widest "
+                f"device window needs overlap {self.overlap}; use chunk_len "
+                f">= {2 * self.overlap}"
+            )
+        self._rules_by_id = {r.id: r for r in self.exact.rules}
+
+        from trivy_tpu.parallel.mesh import pad_batch, sharded_match_fn
+
+        if mesh is not None:
+            inner = sharded_match_fn(match_fn, mesh, rows_multiple=rows_mult)
+            dp = inner.data_parallelism
+            self._match = lambda b: inner(pad_batch(b, dp))
+        elif rows_mult > 1:
+            self._match = lambda b: match_fn(pad_batch(b, rows_mult))
+        else:
+            self._match = match_fn
+
+    # -- core batching loop -------------------------------------------------
+
+    def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
+        """Scan many files; yields per-file results in input order."""
+        # order-preserving result store; files resolve once all chunks matched
+        results: dict[int, Secret] = {}
+        states: dict[int, _FileState] = {}
+        next_emit = 0
+        total = 0
+
+        buf = np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
+        meta: list[int] = []  # file index per buffered chunk
+        inflight: tuple | None = None  # (device_result, meta_snapshot)
+
+        def resolve(batch_hits: np.ndarray, batch_meta: list[int]) -> None:
+            for row, fidx in enumerate(batch_meta):
+                st = states[fidx]
+                st.rules.update(np.nonzero(batch_hits[row])[0].tolist())
+                st.pending -= 1
+                if st.pending == 0:
+                    results[fidx] = self._confirm(st)
+                    del states[fidx]
+
+        def flush():
+            nonlocal inflight, meta, buf
+            if not meta:
+                return
+            batch = buf[: len(meta)]
+            dev = self._match(batch)  # async dispatch
+            prev, inflight = inflight, (dev, meta)
+            meta = []
+            buf = np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
+            if prev is not None:
+                resolve(np.asarray(prev[0]), prev[1])
+
+        def drain() -> None:
+            nonlocal inflight
+            if inflight is not None:
+                dev, m = inflight
+                inflight = None
+                resolve(np.asarray(dev), m)
+
+        for fidx, (path, data) in enumerate(files):
+            total += 1
+            # path-level global allowlist: skip the whole file (ref:
+            # scanner.go:388-392) — no device work either
+            if self.exact.allow_path(path):
+                results[fidx] = Secret(file_path=path)
+            else:
+                starts = chunk_spans(len(data), self.chunk_len, self.overlap)
+                states[fidx] = _FileState(path=path, data=data, pending=len(starts))
+                arr = np.frombuffer(data, dtype=np.uint8)
+                for s in starts:
+                    piece = arr[s : s + self.chunk_len]
+                    buf[len(meta), : len(piece)] = piece
+                    if len(piece) < self.chunk_len:
+                        buf[len(meta), len(piece) :] = 0
+                    meta.append(fidx)
+                    if len(meta) == self.batch_size:
+                        flush()
+            # emit in order as soon as contiguous prefix is done
+            while next_emit in results:
+                yield results.pop(next_emit)
+                next_emit += 1
+        flush()  # dispatch the final partial batch
+        drain()  # resolve whatever is still in flight
+        while next_emit < total:
+            yield results.pop(next_emit)
+            next_emit += 1
+
+    def scan_bytes(self, path: str, data: bytes) -> Secret:
+        """Single-file convenience (still device-prefiltered)."""
+        return next(iter(self.scan_files([(path, data)])))
+
+    # -- host confirmation --------------------------------------------------
+
+    def _confirm(self, st: _FileState) -> Secret:
+        candidate_ids = {self.compiled.rule_ids[i] for i in st.rules}
+        candidate_ids.update(self.compiled.host_rule_ids)
+        if not candidate_ids:
+            return Secret(file_path=st.path)
+        content = st.data.decode("latin-1")
+        lower = content.lower()
+        global_blocks = self.exact.global_block_spans(content)
+        hits = []
+        for rule in self.exact.rules_for_path(st.path):
+            if rule.id not in candidate_ids:
+                continue
+            for loc in self.exact.find_rule_locations(
+                rule, content, lower, global_blocks
+            ):
+                hits.append((rule, loc))
+        return self.exact.build_findings(st.path, content, hits)
