@@ -1,0 +1,142 @@
+"""End-to-end fs scan: walker → analyzers → cache → artifact → driver →
+report, through the CLI surface and the library surface."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+GHP = "ghp_" + "A1b2C3d4E5f6G7h8I9j0K1l2M3n4O5p6Q7r8"
+
+
+@pytest.fixture
+def tree(tmp_path):
+    (tmp_path / "src").mkdir()
+    (tmp_path / "src" / "gh.txt").write_text(f"token {GHP} end\n")
+    (tmp_path / "src" / "clean.py").write_text("print('hello')\n")
+    (tmp_path / "tests").mkdir()
+    (tmp_path / "tests" / "fixture.txt").write_text(f"{GHP}\n")  # allow-path
+    (tmp_path / ".git").mkdir()
+    (tmp_path / ".git" / "cred.txt").write_text(f"{GHP}\n")  # default skip dir
+    (tmp_path / "big.bin").write_bytes(b"\x00\x01\x02" * 100)  # binary
+    return tmp_path
+
+
+def scan_lib(root, cache_dir, scanners=("secret",), backend="cpu"):
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    cache = new_cache("fs", str(cache_dir))
+    artifact = LocalFSArtifact(str(root), cache, ArtifactOption(backend=backend))
+    driver = LocalDriver(cache)
+    return Scanner(artifact, driver).scan_artifact(ScanOptions(scanners=list(scanners)))
+
+
+def test_library_fs_scan(tree, tmp_path):
+    report = scan_lib(tree, tmp_path / "cache")
+    targets = {r.target for r in report.results}
+    assert targets == {"src/gh.txt"}
+    finding = report.results[0].secrets[0]
+    assert finding.rule_id == "github-pat"
+    assert GHP not in finding.match and "****" in finding.match
+
+
+def test_fs_scan_tpu_backend_parity(tree, tmp_path):
+    # virtual-CPU "device" path (XLA kernel) must equal the cpu engine path
+    cpu = scan_lib(tree, tmp_path / "c1", backend="cpu")
+    dev = scan_lib(tree, tmp_path / "c2", backend="auto")
+    strip = lambda d: {k: v for k, v in d.items() if k != "CreatedAt"}
+    assert strip(cpu.to_dict()) == strip(dev.to_dict())
+
+
+def test_cache_reuse(tree, tmp_path):
+    from trivy_tpu.cache import new_cache
+
+    cache_dir = tmp_path / "cache"
+    r1 = scan_lib(tree, cache_dir)
+    cache = new_cache("fs", str(cache_dir))
+    blob_id = None
+    # second scan hits the cache: artifact inspect recomputes the same id
+    r2 = scan_lib(tree, cache_dir)
+    assert [r.target for r in r1.results] == [r.target for r in r2.results]
+
+
+def run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd="/root/repo",
+    )
+
+
+def test_cli_json(tree, tmp_path):
+    p = run_cli(
+        "fs", "--scanners", "secret", "--backend", "cpu", "--format", "json",
+        "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["ArtifactType"] == "filesystem"
+    assert [r["Target"] for r in doc["Results"]] == ["src/gh.txt"]
+    assert doc["Results"][0]["Secrets"][0]["RuleID"] == "github-pat"
+
+
+def test_cli_exit_code_and_severity_filter(tree, tmp_path):
+    p = run_cli(
+        "fs", "--scanners", "secret", "--backend", "cpu", "--exit-code", "7",
+        "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    assert p.returncode == 7
+    p = run_cli(
+        "fs", "--scanners", "secret", "--backend", "cpu", "--exit-code", "7",
+        "--severity", "LOW", "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    assert p.returncode == 0  # CRITICAL finding filtered out
+
+
+def test_cli_ignorefile(tree, tmp_path):
+    ign = tree / ".trivyignore"
+    ign.write_text("# ignore the PAT rule\ngithub-pat\n")
+    p = run_cli(
+        "fs", "--scanners", "secret", "--backend", "cpu", "--format", "json",
+        "--ignorefile", str(ign), "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    doc = json.loads(p.stdout)
+    assert doc["Results"] == []
+
+
+def test_cli_version_and_convert(tree, tmp_path):
+    p = run_cli("version", "--format", "json")
+    assert json.loads(p.stdout)["Version"]
+    # convert: json -> table
+    out = tmp_path / "report.json"
+    run_cli(
+        "fs", "--scanners", "secret", "--backend", "cpu", "--format", "json",
+        "--output", str(out), "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    p = run_cli("convert", "--format", "table", str(out))
+    assert p.returncode == 0, p.stderr
+    assert "github-pat" in p.stdout
+
+
+def test_walker_skips(tmp_path):
+    from trivy_tpu.fanal.walker import FSWalker, WalkOption
+
+    (tmp_path / "keep").mkdir()
+    (tmp_path / "keep" / "a.txt").write_text("x")
+    (tmp_path / "proc").mkdir()
+    (tmp_path / "proc" / "b.txt").write_text("x")
+    (tmp_path / "sub" / ".git").mkdir(parents=True)
+    (tmp_path / "sub" / ".git" / "c.txt").write_text("x")
+    (tmp_path / "skipme").mkdir()
+    (tmp_path / "skipme" / "d.txt").write_text("x")
+    w = FSWalker(WalkOption(skip_dirs=["skipme"]))
+    seen = [rel for rel, _, _ in w.walk(str(tmp_path))]
+    assert seen == ["keep/a.txt"]

@@ -1,0 +1,9 @@
+"""Artifact acquisition (ref: pkg/fanal/artifact).
+
+An Artifact inspects a target (filesystem, image, repo, SBOM, VM) into
+cached blobs and returns a Reference{id, blob_ids}; scan drivers consume
+only cache keys — THE process/network boundary (ref: pkg/scanner/scan.go:134-152,
+SURVEY.md §1 contracts).
+"""
+
+from trivy_tpu.artifact.local_fs import LocalFSArtifact  # noqa: F401

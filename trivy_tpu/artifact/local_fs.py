@@ -1,0 +1,88 @@
+"""Local filesystem artifact (ref: pkg/fanal/artifact/local/fs.go).
+
+Walk → analyze (per-file + batched + post) → handlers → PutBlob. Produces a
+single blob whose ID is the SHA256 of the BlobInfo plus analyzer versions
+(ref: fs.go:175-189 calcCacheKey), making the cache the incremental-scan
+checkpoint.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from trivy_tpu import log
+from trivy_tpu.cache.key import calc_blob_key, calc_key
+from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
+from trivy_tpu.fanal.handler import HandlerManager
+from trivy_tpu.fanal.walker import FSWalker, WalkOption
+from trivy_tpu.types import ArtifactReference
+
+logger = log.logger("artifact:fs")
+
+
+@dataclass
+class ArtifactOption:
+    """Subset of the reference's artifact.Option relevant to fs scans."""
+
+    skip_files: list[str] = field(default_factory=list)
+    skip_dirs: list[str] = field(default_factory=list)
+    disabled_analyzers: list = field(default_factory=list)
+    secret_config_path: str | None = None
+    backend: str = "auto"
+    insecure: bool = False
+
+
+class LocalFSArtifact:
+    type = "filesystem"
+
+    def __init__(self, root: str, cache, option: ArtifactOption | None = None):
+        self.root = root
+        self.cache = cache
+        self.option = option or ArtifactOption()
+        self.group = AnalyzerGroup(
+            AnalyzerOptions(
+                disabled=self.option.disabled_analyzers,
+                secret_config_path=self.option.secret_config_path,
+                backend=self.option.backend,
+            )
+        )
+        self.handlers = HandlerManager()
+        self.walker = FSWalker(
+            WalkOption(
+                skip_files=self.option.skip_files, skip_dirs=self.option.skip_dirs
+            )
+        )
+
+    def inspect(self) -> ArtifactReference:
+        result = AnalysisResult()
+        post_files: dict = {}
+        n_files = 0
+        for rel, info, opener in self.walker.walk(self.root):
+            n_files += 1
+            wanted = self.group.analyze_file(result, self.root, rel, info, opener)
+            for t, content in wanted.items():
+                post_files.setdefault(t, {})[rel] = content
+        self.group.finalize(result, post_files)
+        blob = result.to_blob_info()
+        self.handlers.post_handle(result, blob)
+        blob_dict = blob.to_dict()
+
+        blob_id = calc_key(
+            calc_blob_key(blob_dict),
+            analyzer_versions=self.group.versions(),
+            hook_versions=self.handlers.versions(),
+            skip_files=self.option.skip_files,
+            skip_dirs=self.option.skip_dirs,
+        )
+        _, missing = self.cache.missing_blobs(blob_id, [blob_id])
+        if missing:
+            self.cache.put_blob(blob_id, blob_dict)
+        logger.debug("inspected %d files under %s -> %s", n_files, self.root, blob_id)
+
+        name = self.root
+        if name != os.path.sep:
+            name = name.rstrip(os.path.sep)
+        return ArtifactReference(
+            name=name, type=self.type, id=blob_id, blob_ids=[blob_id]
+        )

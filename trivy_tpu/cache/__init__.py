@@ -1,0 +1,28 @@
+"""Content-addressed scan cache (ref: pkg/cache).
+
+Interfaces mirror the reference split (ref: pkg/cache/cache.go:16-48):
+``ArtifactCache`` is the write side used during artifact inspection
+(PutArtifact/PutBlob/MissingBlobs); ``LocalArtifactCache`` is the read side
+used by scan drivers (GetArtifact/GetBlob). The cache IS the
+checkpoint/resume mechanism: blobs are keyed by
+SHA256(content + analyzer versions + options), so re-scans skip unchanged
+work and bumping an analyzer version invalidates exactly its entries
+(ref: SURVEY.md §5 checkpoint/resume, pkg/cache/key.go).
+"""
+
+from trivy_tpu.cache.key import calc_blob_key, calc_key  # noqa: F401
+from trivy_tpu.cache.fs import FSCache  # noqa: F401
+from trivy_tpu.cache.memory import MemoryCache  # noqa: F401
+
+
+def new_cache(backend: str = "fs", cache_dir: str | None = None):
+    """Cache factory (ref: pkg/cache/cache.go New)."""
+    if backend == "memory":
+        return MemoryCache()
+    if backend in ("fs", ""):
+        return FSCache(cache_dir)
+    if backend.startswith(("http://", "https://")):
+        from trivy_tpu.rpc.client import RemoteCache
+
+        return RemoteCache(backend)
+    raise ValueError(f"unknown cache backend: {backend}")

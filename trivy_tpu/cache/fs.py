@@ -1,0 +1,92 @@
+"""File-backed cache (ref: pkg/cache/fs.go — bolt buckets 'artifact'/'blob').
+
+Layout: ``<cache_dir>/fanal/{artifact,blob}/<sha256-hex>.json``. JSON files
+give the same durability/content-addressing as the reference's bbolt DB
+without a native dependency; keys are already collision-free digests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+def default_cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "trivy-tpu")
+
+
+class FSCache:
+    def __init__(self, cache_dir: str | None = None):
+        self.dir = cache_dir or default_cache_dir()
+        self._adir = os.path.join(self.dir, "fanal", "artifact")
+        self._bdir = os.path.join(self.dir, "fanal", "blob")
+        os.makedirs(self._adir, exist_ok=True)
+        os.makedirs(self._bdir, exist_ok=True)
+
+    @staticmethod
+    def _fname(key: str) -> str:
+        return key.replace("sha256:", "") + ".json"
+
+    def _write(self, dirpath: str, key: str, obj: dict) -> None:
+        path = os.path.join(dirpath, self._fname(key))
+        fd, tmp = tempfile.mkstemp(dir=dirpath, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(obj, f, separators=(",", ":"))
+            os.replace(tmp, path)  # atomic publish
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _read(self, dirpath: str, key: str) -> dict | None:
+        path = os.path.join(dirpath, self._fname(key))
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    # -- ArtifactCache ------------------------------------------------------
+
+    def put_artifact(self, artifact_id: str, info: dict) -> None:
+        self._write(self._adir, artifact_id, info)
+
+    def put_blob(self, blob_id: str, info: dict) -> None:
+        self._write(self._bdir, blob_id, info)
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list[str]) -> tuple[bool, list[str]]:
+        missing_artifact = self.get_artifact(artifact_id) is None
+        missing = [b for b in blob_ids if self.get_blob(b) is None]
+        return missing_artifact, missing
+
+    def delete_blobs(self, blob_ids: list[str]) -> None:
+        for b in blob_ids:
+            try:
+                os.unlink(os.path.join(self._bdir, self._fname(b)))
+            except OSError:
+                pass
+
+    # -- LocalArtifactCache -------------------------------------------------
+
+    def get_artifact(self, artifact_id: str) -> dict | None:
+        return self._read(self._adir, artifact_id)
+
+    def get_blob(self, blob_id: str) -> dict | None:
+        return self._read(self._bdir, blob_id)
+
+    def close(self) -> None:
+        pass
+
+    def clear(self) -> None:
+        import shutil
+
+        shutil.rmtree(os.path.join(self.dir, "fanal"), ignore_errors=True)
+        os.makedirs(self._adir, exist_ok=True)
+        os.makedirs(self._bdir, exist_ok=True)

@@ -1,0 +1,216 @@
+"""CLI entry point (ref: pkg/commands/app.go cobra tree).
+
+Command tree: fs / rootfs / repo / image / sbom / convert / server / clean /
+version, sharing flag groups the way the reference composes FlagGroups per
+command (ref: app.go:247+ per-target constructors).
+
+Run as ``python -m trivy_tpu.cli <command> ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from trivy_tpu import log
+from trivy_tpu.flag import Flag, FlagGroup, load_config_file, resolve_all
+
+VERSION = "0.1.0"
+
+SCANNERS = ["vuln", "misconfig", "secret", "license"]
+FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json", "github", "template"]
+
+
+def global_flags() -> FlagGroup:
+    return FlagGroup(
+        "global",
+        [
+            Flag("debug", default=False, value_type=bool, help="debug logging",
+                 config_name="debug", short="d"),
+            Flag("quiet", default=False, value_type=bool, help="errors only",
+                 config_name="quiet", short="q"),
+            Flag("cache-dir", default=None, help="cache directory",
+                 config_name="cache.dir"),
+            Flag("config", default=None, help="config file path", short="c"),
+            Flag("timeout", default=300, value_type=int, config_name="timeout",
+                 help="scan timeout seconds (ref default 5m)"),
+        ],
+    )
+
+
+def scan_flags() -> FlagGroup:
+    return FlagGroup(
+        "scan",
+        [
+            Flag("scanners", default=["secret"], is_list=True, choices=SCANNERS,
+                 config_name="scan.scanners", help="comma-separated scanners"),
+            Flag("skip-dirs", default=[], is_list=True, config_name="scan.skip-dirs",
+                 help="directories to skip"),
+            Flag("skip-files", default=[], is_list=True, config_name="scan.skip-files",
+                 help="files to skip"),
+            Flag("backend", default="auto", choices=["auto", "pallas", "xla", "cpu"],
+                 config_name="scan.backend",
+                 help="device backend for batched engines"),
+            Flag("parallel", default=0, value_type=int, config_name="scan.parallel",
+                 help="host worker count (0 = auto)"),
+        ],
+    )
+
+
+def report_flags() -> FlagGroup:
+    return FlagGroup(
+        "report",
+        [
+            Flag("format", default="table", choices=FORMATS, short="f",
+                 config_name="format", help="output format"),
+            Flag("output", default=None, short="o", config_name="output",
+                 help="output file (default stdout)"),
+            Flag("severity", default=[], is_list=True,
+                 choices=["UNKNOWN", "LOW", "MEDIUM", "HIGH", "CRITICAL"],
+                 config_name="severity", help="severities to include"),
+            Flag("exit-code", default=0, value_type=int, config_name="exit-code",
+                 help="exit code when findings exist"),
+            Flag("ignorefile", default=".trivyignore", config_name="ignorefile",
+                 help="ignore file path"),
+            Flag("ignore-policy", default=None, config_name="ignore-policy",
+                 help="filter findings with a policy file"),
+            Flag("template", default=None, short="t", config_name="template",
+                 help="go-template style output template (for --format template)"),
+            Flag("list-all-pkgs", default=False, value_type=bool,
+                 config_name="list-all-pkgs", help="include all packages in report"),
+        ],
+    )
+
+
+def secret_flags() -> FlagGroup:
+    return FlagGroup(
+        "secret",
+        [
+            Flag("secret-config", default="trivy-secret.yaml",
+                 config_name="secret.config", help="secret rules config file"),
+        ],
+    )
+
+
+def license_flags() -> FlagGroup:
+    return FlagGroup(
+        "license",
+        [
+            Flag("license-full", default=False, value_type=bool,
+                 config_name="license.full",
+                 help="also classify licenses in loose files/headers"),
+        ],
+    )
+
+
+def db_flags() -> FlagGroup:
+    return FlagGroup(
+        "db",
+        [
+            Flag("skip-db-update", default=False, value_type=bool,
+                 config_name="db.skip-update", help="do not refresh the vuln DB"),
+            Flag("db-repository", default=None, config_name="db.repository",
+                 help="advisory DB location (dir or archive)"),
+            Flag("offline-scan", default=False, value_type=bool,
+                 config_name="offline-scan", help="no network access"),
+        ],
+    )
+
+
+def server_client_flags() -> FlagGroup:
+    return FlagGroup(
+        "client/server",
+        [
+            Flag("server", default=None, config_name="server.addr",
+                 help="server address for client mode (http://host:port)"),
+            Flag("token", default=None, config_name="server.token",
+                 help="server auth token"),
+        ],
+    )
+
+
+_TARGET_GROUPS = {
+    "fs": [global_flags, scan_flags, report_flags, secret_flags, license_flags,
+           db_flags, server_client_flags],
+    "rootfs": [global_flags, scan_flags, report_flags, secret_flags,
+               license_flags, db_flags, server_client_flags],
+    "repo": [global_flags, scan_flags, report_flags, secret_flags,
+             license_flags, db_flags, server_client_flags],
+    "image": [global_flags, scan_flags, report_flags, secret_flags,
+              license_flags, db_flags, server_client_flags],
+    "sbom": [global_flags, scan_flags, report_flags, db_flags,
+             server_client_flags],
+    "convert": [global_flags, report_flags],
+    "server": [global_flags, db_flags],
+    "clean": [global_flags],
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="trivy-tpu", description="TPU-native security scanner"
+    )
+    sub = parser.add_subparsers(dest="command")
+    groups_by_cmd: dict[str, list[FlagGroup]] = {}
+
+    help_by_cmd = {
+        "fs": "scan a local filesystem",
+        "rootfs": "scan an exported root filesystem",
+        "repo": "scan a git repository (local path or remote URL)",
+        "image": "scan a container image (archive or OCI layout)",
+        "sbom": "scan an SBOM (CycloneDX/SPDX) for vulnerabilities",
+        "convert": "convert a saved JSON report into another format",
+        "server": "run the scan server",
+        "clean": "clean caches and databases",
+    }
+    for cmd, factories in _TARGET_GROUPS.items():
+        p = sub.add_parser(cmd, help=help_by_cmd.get(cmd, cmd))
+        groups = [f() for f in factories]
+        for g in groups:
+            g.add_to_parser(p)
+        groups_by_cmd[cmd] = groups
+        if cmd == "server":
+            p.add_argument("--listen", default="0.0.0.0:4954",
+                           help="listen address")
+        elif cmd == "clean":
+            p.add_argument("--all", action="store_true", dest="clean_all")
+            p.add_argument("--scan-cache", action="store_true")
+        else:
+            p.add_argument("target", help="scan target")
+
+    vp = sub.add_parser("version", help="print version")
+    vp.add_argument("--format", default="text", choices=["text", "json"])
+    parser._groups_by_cmd = groups_by_cmd  # type: ignore[attr-defined]
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    ns = parser.parse_args(argv)
+    if ns.command is None:
+        parser.print_help()
+        return 0
+    if ns.command == "version":
+        if ns.format == "json":
+            import json
+
+            print(json.dumps({"Version": VERSION}))
+        else:
+            print(f"trivy-tpu version {VERSION}")
+        return 0
+
+    groups = parser._groups_by_cmd[ns.command]  # type: ignore[attr-defined]
+    try:
+        config = load_config_file(getattr(ns, "config", None))
+        opts = resolve_all(groups, ns, config)
+    except (ValueError, FileNotFoundError) as e:
+        parser.error(str(e))
+    log.init(debug=opts.get("debug", False), quiet=opts.get("quiet", False))
+
+    from trivy_tpu.commands import run
+
+    return run(ns.command, ns, opts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

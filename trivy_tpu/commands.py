@@ -1,0 +1,229 @@
+"""Command orchestration (ref: pkg/commands/artifact/run.go).
+
+Mode selection (standalone vs client/server × target kind), scanner
+construction, scan → filter → report → exit-code — the reference Runner's
+responsibilities (ref: run.go:337-400), minus wire DI.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from trivy_tpu import log
+from trivy_tpu.scanner import ScanOptions, Scanner
+
+logger = log.logger("commands")
+
+
+def _make_cache(opts):
+    from trivy_tpu.cache import new_cache
+
+    return new_cache("fs", opts.get("cache_dir"))
+
+
+def _artifact_option(ns, opts):
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+
+    backend = opts.get("backend", "auto")
+    if backend == "cpu":
+        device_backend = "cpu"
+    elif backend == "auto":
+        device_backend = "auto"
+    else:
+        device_backend = backend
+    disabled = []
+    scanners = opts.get("scanners", [])
+    from trivy_tpu.fanal.analyzer import AnalyzerType
+
+    if "secret" not in scanners:
+        disabled.append(AnalyzerType.SECRET)
+    if "license" not in scanners or not opts.get("license_full"):
+        disabled.append(AnalyzerType.LICENSE_FILE)
+        disabled.append(AnalyzerType.LICENSE_HEADER)
+    if "misconfig" not in scanners:
+        disabled.append(AnalyzerType.CONFIG)
+    import os.path
+
+    secret_cfg = opts.get("secret_config")
+    if secret_cfg and not os.path.exists(secret_cfg):
+        secret_cfg = None
+    return ArtifactOption(
+        skip_files=opts.get("skip_files", []),
+        skip_dirs=opts.get("skip_dirs", []),
+        disabled_analyzers=disabled,
+        secret_config_path=secret_cfg,
+        backend=device_backend,
+    )
+
+
+def _scan_options(opts) -> ScanOptions:
+    return ScanOptions(
+        scanners=opts.get("scanners", ["secret"]),
+        license_full=bool(opts.get("license_full")),
+    )
+
+
+def _vuln_client(opts):
+    """Advisory DB client, when the vuln scanner is enabled and a DB exists."""
+    if "vuln" not in opts.get("scanners", []):
+        return None
+    from trivy_tpu.db import load_default_db
+
+    db = load_default_db(opts.get("db_repository"), opts.get("cache_dir"))
+    if db is None:
+        logger.warning("vulnerability DB not available; skipping vuln detection")
+        return None
+    return db
+
+
+def run(command: str, ns, opts) -> int:
+    import signal
+
+    timeout = int(opts.get("timeout") or 0)
+
+    def on_timeout(signum, frame):
+        raise TimeoutError(f"scan exceeded --timeout={timeout}s")
+
+    if timeout > 0 and command != "server":
+        signal.signal(signal.SIGALRM, on_timeout)
+        signal.alarm(timeout)
+    try:
+        if command in ("fs", "rootfs", "repo"):
+            return _run_fs_like(command, ns, opts)
+        if command == "image":
+            return _run_image(ns, opts)
+        if command == "sbom":
+            return _run_sbom(ns, opts)
+        if command == "convert":
+            return _run_convert(ns, opts)
+        if command == "server":
+            return _run_server(ns, opts)
+        if command == "clean":
+            return _run_clean(ns, opts)
+        raise ValueError(f"unknown command {command}")
+    except TimeoutError as e:
+        logger.error("%s", e)
+        return 1
+    except ModuleNotFoundError as e:
+        if (e.name or "").startswith("trivy_tpu"):
+            logger.error(
+                "this feature is not implemented yet (missing %s)", e.name
+            )
+            return 2
+        raise
+    finally:
+        if timeout > 0 and command != "server":
+            signal.alarm(0)
+
+
+def _emit(report, ns, opts) -> int:
+    from trivy_tpu import report as report_pkg
+    from trivy_tpu.result import FilterOptions, filter_report
+
+    filter_report(
+        report,
+        FilterOptions(
+            severities=opts.get("severity") or [],
+            ignore_file=opts.get("ignorefile"),
+        ),
+    )
+    output = opts.get("output")
+    kw = {}
+    if opts.get("template"):
+        kw["template"] = opts["template"]
+    if output:
+        with open(output, "w") as f:
+            report_pkg.write(report, opts.get("format", "table"), f, **kw)
+    else:
+        report_pkg.write(report, opts.get("format", "table"), sys.stdout, **kw)
+    exit_code = opts.get("exit_code", 0)
+    if exit_code and any(not r.is_empty for r in report.results):
+        return exit_code
+    return 0
+
+
+def _run_fs_like(command: str, ns, opts) -> int:
+    from trivy_tpu.artifact.local_fs import LocalFSArtifact
+
+    target = ns.target
+    cache = _make_cache(opts)
+    art_opt = _artifact_option(ns, opts)
+
+    if command == "repo" and (
+        target.startswith(("http://", "https://", "git://")) or target.endswith(".git")
+    ):
+        from trivy_tpu.artifact.repo import checkout_repo
+
+        target = checkout_repo(target)
+
+    artifact = LocalFSArtifact(target, cache, art_opt)
+    server = opts.get("server")
+    if server:
+        from trivy_tpu.rpc.client import RemoteDriver
+
+        driver = RemoteDriver(server, token=opts.get("token"))
+    else:
+        from trivy_tpu.scanner.local_driver import LocalDriver
+
+        driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    scanner = Scanner(artifact, driver)
+    report = scanner.scan_artifact(_scan_options(opts))
+    return _emit(report, ns, opts)
+
+
+def _run_image(ns, opts) -> int:
+    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    cache = _make_cache(opts)
+    artifact = ImageArchiveArtifact(ns.target, cache, _artifact_option(ns, opts))
+    driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
+    return _emit(report, ns, opts)
+
+
+def _run_sbom(ns, opts) -> int:
+    from trivy_tpu.artifact.sbom import SBOMArtifact
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    cache = _make_cache(opts)
+    artifact = SBOMArtifact(ns.target, cache)
+    opts = dict(opts)
+    opts.setdefault("scanners", ["vuln"])
+    if "vuln" not in opts["scanners"]:
+        opts["scanners"] = ["vuln"]
+    driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
+    return _emit(report, ns, opts)
+
+
+def _run_convert(ns, opts) -> int:
+    import json
+
+    from trivy_tpu.types import Report
+
+    with open(ns.target) as f:
+        report = Report.from_dict(json.load(f))
+    return _emit(report, ns, opts)
+
+
+def _run_server(ns, opts) -> int:
+    from trivy_tpu.rpc.server import serve
+
+    host, _, port = ns.listen.rpartition(":")
+    serve(host or "0.0.0.0", int(port), cache_dir=opts.get("cache_dir"))
+    return 0
+
+
+def _run_clean(ns, opts) -> int:
+    """Selective cleanup (ref: pkg/commands/clean/run.go — requires an
+    explicit selector)."""
+    if not (getattr(ns, "clean_all", False) or getattr(ns, "scan_cache", False)):
+        logger.error("specify what to clean: --scan-cache or --all")
+        return 1
+    from trivy_tpu.cache import new_cache
+
+    cache = new_cache("fs", opts.get("cache_dir"))
+    cache.clear()
+    logger.info("scan cache cleared")
+    return 0

@@ -1,0 +1,1 @@
+"""fanal: artifact acquisition and analysis (ref: pkg/fanal)."""

@@ -1,0 +1,324 @@
+"""Analyzer registry and analysis group (ref: pkg/fanal/analyzer/analyzer.go).
+
+The reference fans out one goroutine per (file × analyzer) bounded by a
+weighted semaphore (ref: analyzer.go:403-455) and merges results under a
+mutex. The TPU-first redesign keeps the same *contract* — per-file
+``required(path, info)`` prefilter, ``analyze(input) -> AnalysisResult``,
+versioned types feeding cache keys — but adds a first-class **batched
+analyzer** protocol: a batched analyzer collects eligible files during the
+walk and analyzes them all at once at the end, which is what lets the secret
+engine ship chunk batches to the device instead of scanning file-by-file.
+
+Post-analyzers receive a virtual filesystem of pre-selected files (ref:
+analyzer.go:475-510), used by lockfile parsers that need sibling files.
+
+Results are merged and sorted deterministically (ref: analyzer.go:188-301)
+so output is stable under any execution order.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections.abc import Callable
+from dataclasses import dataclass, field
+
+from trivy_tpu import log
+from trivy_tpu.fanal.walker import FileInfo
+from trivy_tpu.types import (
+    Application,
+    BlobInfo,
+    CustomResource,
+    LicenseFile,
+    Misconfiguration,
+    OS,
+    PackageInfo,
+    Secret,
+)
+
+logger = log.logger("analyzer")
+
+
+class AnalyzerType(str, enum.Enum):
+    """Analyzer type constants (subset of ref: pkg/fanal/analyzer/const.go)."""
+
+    # OS
+    OS_RELEASE = "os-release"
+    ALPINE = "alpine"
+    DEBIAN = "debian"
+    UBUNTU = "ubuntu"
+    REDHAT = "redhat"
+    AMAZON = "amazon"
+    # OS packages
+    APK = "apk"
+    DPKG = "dpkg"
+    RPM = "rpm"
+    # language ecosystems (post-analyzers over lockfiles)
+    BUNDLER = "bundler"
+    CARGO = "cargo"
+    COMPOSER = "composer"
+    GO_MOD = "gomod"
+    GO_BINARY = "gobinary"
+    GRADLE_LOCK = "gradle-lockfile"
+    JAR = "jar"
+    NPM_PKG_LOCK = "npm"
+    NODE_PKG = "node-pkg"
+    PNPM = "pnpm"
+    YARN = "yarn"
+    PIP = "pip"
+    PIPENV = "pipenv"
+    POETRY = "poetry"
+    UV = "uv"
+    CONAN = "conan-lock"
+    NUGET = "nuget"
+    DOTNET_DEPS = "dotnet-core"
+    PUB_SPEC = "pubspec-lock"
+    MIX_LOCK = "mix-lock"
+    SWIFT = "swift"
+    COCOAPODS = "cocoapods"
+    CONDA_PKG = "conda-pkg"
+    JULIA = "julia"
+    # others
+    SECRET = "secret"
+    LICENSE_FILE = "license-file"
+    LICENSE_HEADER = "license-header"
+    CONFIG = "config"
+    SBOM = "sbom"
+
+
+@dataclass
+class AnalysisInput:
+    """Per-file input (ref: analyzer.go AnalysisInput)."""
+
+    dir: str  # scan root ("" for image layers)
+    file_path: str  # posix path relative to root
+    info: FileInfo
+    content: bytes
+
+
+@dataclass
+class AnalysisResult:
+    """Thread/batch-safe accumulation of everything analyzers produce
+    (ref: analyzer.go:251-301)."""
+
+    os: OS | None = None
+    repository: dict | None = None
+    package_infos: list[PackageInfo] = field(default_factory=list)
+    applications: list[Application] = field(default_factory=list)
+    misconfigurations: list[Misconfiguration] = field(default_factory=list)
+    secrets: list[Secret] = field(default_factory=list)
+    licenses: list[LicenseFile] = field(default_factory=list)
+    custom_resources: list[CustomResource] = field(default_factory=list)
+    system_files: list[str] = field(default_factory=list)  # for sysfile filter
+
+    def merge(self, other: "AnalysisResult | None") -> None:
+        if other is None:
+            return
+        if other.os is not None:
+            self.os = self.os.merge(other.os) if self.os else other.os
+        if other.repository is not None:
+            self.repository = other.repository
+        self.package_infos.extend(other.package_infos)
+        self.applications.extend(other.applications)
+        self.misconfigurations.extend(other.misconfigurations)
+        self.secrets.extend(other.secrets)
+        self.licenses.extend(other.licenses)
+        self.custom_resources.extend(other.custom_resources)
+        self.system_files.extend(other.system_files)
+
+    def sort(self) -> None:
+        """Deterministic ordering (ref: analyzer.go:188-249)."""
+        self.package_infos.sort(key=lambda p: p.file_path)
+        for pi in self.package_infos:
+            pi.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
+        self.applications.sort(key=lambda a: (a.file_path, a.type))
+        for app in self.applications:
+            app.packages.sort(key=lambda p: (p.name, p.version, p.file_path))
+        self.misconfigurations.sort(key=lambda m: m.file_path)
+        self.secrets.sort(key=lambda s: s.file_path)
+        self.licenses.sort(key=lambda l: (l.file_path, l.pkg_name))
+        self.custom_resources.sort(key=lambda c: (c.file_path, c.type))
+
+    def to_blob_info(self) -> BlobInfo:
+        self.sort()
+        return BlobInfo(
+            os=self.os,
+            repository=self.repository,
+            package_infos=self.package_infos,
+            applications=self.applications,
+            misconfigurations=self.misconfigurations,
+            secrets=self.secrets,
+            licenses=self.licenses,
+            custom_resources=self.custom_resources,
+        )
+
+
+class Analyzer:
+    """Per-file analyzer contract (ref: analyzer.go:72-84)."""
+
+    type: AnalyzerType
+    version: int = 1
+
+    def required(self, file_path: str, info: FileInfo) -> bool:
+        raise NotImplementedError
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        raise NotImplementedError
+
+
+class BatchAnalyzer:
+    """TPU-first batched analyzer: collect during the walk, analyze once.
+
+    ``collect`` receives each eligible file; ``finalize`` runs after the walk
+    and returns one merged result (this is where chunk batches hit the
+    device).
+    """
+
+    type: AnalyzerType
+    version: int = 1
+
+    def required(self, file_path: str, info: FileInfo) -> bool:
+        raise NotImplementedError
+
+    def collect(self, inp: AnalysisInput) -> None:
+        raise NotImplementedError
+
+    def finalize(self) -> AnalysisResult | None:
+        raise NotImplementedError
+
+
+class PostAnalyzer:
+    """Post-analyzer over a virtual FS of pre-selected files
+    (ref: analyzer.go:475-510)."""
+
+    type: AnalyzerType
+    version: int = 1
+
+    def required(self, file_path: str, info: FileInfo) -> bool:
+        raise NotImplementedError
+
+    def post_analyze(self, files: dict[str, bytes]) -> AnalysisResult | None:
+        """``files``: path -> content for every file this analyzer required."""
+        raise NotImplementedError
+
+
+_analyzers: dict[AnalyzerType, Callable[..., Analyzer | BatchAnalyzer]] = {}
+_post_analyzers: dict[AnalyzerType, Callable[..., PostAnalyzer]] = {}
+
+
+def register_analyzer(factory) -> None:
+    """Global registry (ref: analyzer.go:26-27 RegisterAnalyzer)."""
+    t = factory.type
+    if t in _analyzers:
+        raise ValueError(f"analyzer {t} registered twice")
+    _analyzers[t] = factory
+
+
+def register_post_analyzer(factory) -> None:
+    t = factory.type
+    if t in _post_analyzers:
+        raise ValueError(f"post-analyzer {t} registered twice")
+    _post_analyzers[t] = factory
+
+
+def deregister_analyzer(t: AnalyzerType) -> None:
+    _analyzers.pop(t, None)
+    _post_analyzers.pop(t, None)
+
+
+@dataclass
+class AnalyzerOptions:
+    """Group construction options (ref: analyzer.go AnalyzerOptions)."""
+
+    disabled: list[AnalyzerType] = field(default_factory=list)
+    secret_config_path: str | None = None
+    backend: str = "auto"  # device backend for batched analyzers
+    file_checksum: bool = False
+    extra: dict = field(default_factory=dict)
+
+
+class AnalyzerGroup:
+    """The set of enabled analyzers for one scan (ref: analyzer.go:321-377)."""
+
+    def __init__(self, options: AnalyzerOptions | None = None):
+        import trivy_tpu.fanal.analyzers  # noqa: F401  (registers built-ins)
+
+        opts = options or AnalyzerOptions()
+        disabled = set(opts.disabled)
+        self.analyzers: list[Analyzer] = []
+        self.batch_analyzers: list[BatchAnalyzer] = []
+        self.post_analyzers: list[PostAnalyzer] = []
+        for t, factory in sorted(_analyzers.items(), key=lambda kv: kv[0].value):
+            if t in disabled:
+                continue
+            a = factory(opts)
+            if isinstance(a, BatchAnalyzer):
+                self.batch_analyzers.append(a)
+            else:
+                self.analyzers.append(a)
+        for t, factory in sorted(_post_analyzers.items(), key=lambda kv: kv[0].value):
+            if t not in disabled:
+                self.post_analyzers.append(factory(opts))
+
+    def versions(self) -> dict[str, int]:
+        """type -> version map, part of every cache key
+        (ref: pkg/fanal/artifact/local/fs.go:183)."""
+        out = {}
+        for a in self.analyzers + self.batch_analyzers + self.post_analyzers:
+            out[a.type.value] = a.version
+        return dict(sorted(out.items()))
+
+    # -- execution ----------------------------------------------------------
+
+    def analyze_file(
+        self, result: AnalysisResult, dir: str, file_path: str, info: FileInfo, opener
+    ) -> dict[AnalyzerType, bytes]:
+        """Run per-file and collect batched analyzers on one file; returns
+        content for post-analyzers that claimed the file."""
+        content: bytes | None = None
+        post_wanted: dict[AnalyzerType, bytes] = {}
+
+        def load() -> bytes:
+            nonlocal content
+            if content is None:
+                content = opener()
+            return content
+
+        for a in self.analyzers:
+            if not a.required(file_path, info):
+                continue
+            try:
+                r = a.analyze(
+                    AnalysisInput(dir=dir, file_path=file_path, info=info, content=load())
+                )
+                result.merge(r)
+            except Exception as e:  # analyzer errors are logged, never fatal
+                logger.warning("analyzer %s failed on %s: %s", a.type.value, file_path, e)
+        for a in self.batch_analyzers:
+            if not a.required(file_path, info):
+                continue
+            try:
+                a.collect(
+                    AnalysisInput(dir=dir, file_path=file_path, info=info, content=load())
+                )
+            except Exception as e:
+                logger.warning("collector %s failed on %s: %s", a.type.value, file_path, e)
+        for a in self.post_analyzers:
+            if a.required(file_path, info):
+                post_wanted[a.type] = load()
+        return post_wanted
+
+    def finalize(self, result: AnalysisResult, post_files: dict[AnalyzerType, dict[str, bytes]]) -> None:
+        """Run batch finalizers and post-analyzers, merging into result."""
+        for a in self.batch_analyzers:
+            try:
+                result.merge(a.finalize())
+            except Exception as e:
+                logger.warning("batch analyzer %s failed: %s", a.type.value, e)
+        for a in self.post_analyzers:
+            files = post_files.get(a.type, {})
+            if not files:
+                continue
+            try:
+                result.merge(a.post_analyze(files))
+            except Exception as e:
+                logger.warning("post-analyzer %s failed: %s", a.type.value, e)

@@ -1,0 +1,4 @@
+"""Built-in analyzers; importing this package registers them all
+(ref: each reference analyzer registers via init(), pkg/fanal/analyzer)."""
+
+from trivy_tpu.fanal.analyzers import secret  # noqa: F401

@@ -1,0 +1,144 @@
+"""Secret analyzer: file eligibility + batched device scanning.
+
+Mirrors the reference's pre-filters exactly (ref:
+pkg/fanal/analyzer/secret/secret.go:152-190 — min size 10 bytes, skip dirs
+.git/node_modules, skip lockfiles, skip binary-ish extensions, global allow
+paths) and its content normalization (ref: secret.go:103-150 — binary sniff
+with printable-strings fallback for allowed binaries, CR stripping, leading
+'/' for image layers). The scan itself is the TPU-first divergence: files
+are *collected* during the walk and shipped to the device in chunk batches
+via TpuSecretScanner (exact host confirm keeps findings byte-identical).
+"""
+
+from __future__ import annotations
+
+import os.path
+
+from trivy_tpu.fanal import utils
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    AnalyzerType,
+    BatchAnalyzer,
+    register_analyzer,
+)
+from trivy_tpu import log
+from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
+
+logger = log.logger("secret")
+
+# ref: secret.go:28-62
+SKIP_FILES = {
+    "go.mod",
+    "go.sum",
+    "package-lock.json",
+    "yarn.lock",
+    "pnpm-lock.yaml",
+    "Pipfile.lock",
+    "Gemfile.lock",
+}
+SKIP_DIRS = {".git", "node_modules"}
+SKIP_EXTS = {
+    ".jpg", ".png", ".gif", ".doc", ".pdf", ".bin", ".svg", ".socket",
+    ".deb", ".rpm", ".zip", ".gz", ".gzip", ".tar",
+}
+ALLOWED_BINARIES = {".pyc"}
+
+LARGE_FILE_WARN = 10 * 1024 * 1024  # ref: secret.go:110
+# flush collected files to the device once this much content is buffered,
+# bounding host memory on large trees
+BATCH_FLUSH_BYTES = 64 * 1024 * 1024
+
+
+class SecretAnalyzer(BatchAnalyzer):
+    type = AnalyzerType.SECRET
+    version = 1
+
+    def __init__(self, options):
+        cfg = None
+        self.config_path = getattr(options, "secret_config_path", None)
+        if self.config_path and os.path.exists(self.config_path):
+            cfg = ScannerConfig.from_yaml_file(self.config_path)
+        backend = getattr(options, "backend", "auto")
+        self._config = cfg
+        self._backend = backend
+        self._scanner = None  # built lazily so CPU-only runs never touch jax
+        self._files: list[tuple[str, bytes]] = []
+        self._buffered = 0
+        self._found: list = []
+
+    def required(self, file_path: str, info) -> bool:
+        if info.size < 10:
+            return False
+        parts = file_path.split("/")
+        if any(p in SKIP_DIRS for p in parts[:-1]):
+            return False
+        name = parts[-1]
+        if name in SKIP_FILES:
+            return False
+        if self.config_path and os.path.basename(self.config_path) == file_path:
+            return False
+        ext = os.path.splitext(name)[1]
+        if ext in SKIP_EXTS:
+            return False
+        # global allow paths checked with the exact engine's rule set
+        if self._exact().allow_path(self._normalize(file_path, dir_="x")):
+            return False
+        return True
+
+    def _exact(self) -> SecretScanner:
+        if self._scanner is None:
+            if self._backend == "cpu":
+                self._scanner = SecretScanner(self._config)
+            else:
+                from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+                self._scanner = TpuSecretScanner(self._config)
+        return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
+
+    @staticmethod
+    def _normalize(file_path: str, dir_: str) -> str:
+        # files extracted from image layers get a leading '/' (ref:
+        # secret.go:131-137)
+        return file_path if dir_ else f"/{file_path}"
+
+    def collect(self, inp: AnalysisInput) -> None:
+        head = inp.content[:300]
+        binary = utils.is_binary(head)
+        ext = os.path.splitext(inp.file_path)[1]
+        if binary and ext not in ALLOWED_BINARIES:
+            return
+        if len(inp.content) > LARGE_FILE_WARN:
+            logger.warning(
+                "large file in secret scan (%d MB): %s — consider --skip-files",
+                len(inp.content) >> 20,
+                inp.file_path,
+            )
+        if binary:
+            content = utils.extract_printable_bytes(inp.content)
+        else:
+            content = inp.content.replace(b"\r", b"")
+        self._files.append((self._normalize(inp.file_path, inp.dir), content))
+        self._buffered += len(content)
+        if self._buffered >= BATCH_FLUSH_BYTES:
+            self._flush()
+
+    def _flush(self) -> None:
+        if not self._files:
+            return
+        files, self._files, self._buffered = self._files, [], 0
+        self._exact()  # ensure scanner exists
+        scanner = self._scanner
+        if hasattr(scanner, "scan_files"):
+            secrets = scanner.scan_files(files)
+        else:
+            secrets = (scanner.scan_bytes(p, d) for p, d in files)
+        self._found.extend(s for s in secrets if s.findings)
+
+    def finalize(self) -> AnalysisResult | None:
+        self._flush()
+        found, self._found = self._found, []
+        return AnalysisResult(secrets=found) if found else AnalysisResult()
+
+
+register_analyzer(SecretAnalyzer)

@@ -1,0 +1,78 @@
+"""Layer applier: merge per-layer BlobInfos into one ArtifactDetail with
+overlayfs semantics (ref: pkg/fanal/applier/docker.go:94-165).
+
+Whiteout files delete the shadowed path; opaque dirs delete everything the
+lower layers put under them; later layers win for OS identity and
+same-path packages/apps; secrets/licenses/misconfigs carry their layer id.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.types import ArtifactDetail, BlobInfo
+
+
+def _deleted_by_whiteouts(path: str, whiteouts: list[str], opaques: list[str]) -> bool:
+    if path in whiteouts:
+        return True
+    return any(path == od or path.startswith(od.rstrip("/") + "/") for od in opaques)
+
+
+def apply_layers(blobs: list[BlobInfo]) -> ArtifactDetail:
+    """Merge blobs bottom-to-top (ref: docker.go:94 ApplyLayers)."""
+    detail = ArtifactDetail()
+    pkg_by_path: dict[str, object] = {}
+    app_by_path: dict[str, object] = {}
+    secret_by_path: dict[str, object] = {}
+    lic_by_key: dict[tuple, object] = {}
+    misconf_by_path: dict[str, object] = {}
+
+    for blob in blobs:
+        layer = blob.diff_id
+        whiteouts = blob.whiteout_files
+        opaques = blob.opaque_dirs
+        if whiteouts or opaques:
+            for d in (pkg_by_path, secret_by_path, misconf_by_path):
+                for path in [
+                    p for p in d if _deleted_by_whiteouts(p, whiteouts, opaques)
+                ]:
+                    del d[path]
+            for d in (app_by_path, lic_by_key):  # tuple keys: path first
+                for key in [
+                    k for k in d if _deleted_by_whiteouts(k[0], whiteouts, opaques)
+                ]:
+                    del d[key]
+
+        if blob.os is not None:
+            detail.os = detail.os.merge(blob.os) if detail.os else blob.os
+        if blob.repository is not None:
+            detail.repository = blob.repository
+
+        for pi in blob.package_infos:
+            for p in pi.packages:
+                p.layer = p.layer or layer
+            pkg_by_path[pi.file_path] = pi
+        for app in blob.applications:
+            for p in app.packages:
+                p.layer = p.layer or layer
+            app_by_path[(app.file_path, app.type)] = app
+        for sec in blob.secrets:
+            for f in sec.findings:
+                f.layer = f.layer or layer
+            secret_by_path[sec.file_path] = sec
+        for lic in blob.licenses:
+            lic.layer = lic.layer or layer
+            lic_by_key[(lic.file_path, lic.pkg_name, lic.type)] = lic
+        for mc in blob.misconfigurations:
+            mc.layer = mc.layer or layer
+            misconf_by_path[mc.file_path] = mc
+        detail.custom_resources.extend(blob.custom_resources)
+
+    for pi in sorted(pkg_by_path.values(), key=lambda p: p.file_path):
+        detail.packages.extend(pi.packages)
+    detail.applications = [
+        app_by_path[k] for k in sorted(app_by_path, key=lambda k: (k[0], k[1]))
+    ]
+    detail.secrets = [secret_by_path[k] for k in sorted(secret_by_path)]
+    detail.licenses = [lic_by_key[k] for k in sorted(lic_by_key)]
+    detail.misconfigurations = [misconf_by_path[k] for k in sorted(misconf_by_path)]
+    return detail

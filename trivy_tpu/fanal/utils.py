@@ -1,0 +1,41 @@
+"""Content sniffing helpers (ref: pkg/fanal/utils/utils.go)."""
+
+from __future__ import annotations
+
+# Control bytes that mark content as binary when found in the head
+# (ref: utils.go:85-100 — a 300-byte sniff for non-printable characters).
+_SNIFF_LEN = 300
+_PRINTABLE_MIN = 7  # below \a => control
+_MIN_PRINTABLE_RUN = 4
+
+
+def is_binary(head: bytes) -> bool:
+    """True when the first bytes look like a binary file.
+
+    Mirrors the reference's control-byte sniff (ref: pkg/fanal/utils/utils.go:85-100):
+    any byte outside the printable range in the first 300 bytes marks binary.
+    """
+    for b in head[:_SNIFF_LEN]:
+        if b < _PRINTABLE_MIN or (13 < b < 27) or (27 < b < 32) or b == 127:
+            return True
+    return False
+
+
+def extract_printable_bytes(data: bytes) -> bytes:
+    """strings(1)-like extraction of printable runs from binary content
+    (ref: pkg/fanal/utils/utils.go:128+): runs of >=4 printable characters,
+    newline-joined, so secret scanning still sees embedded credentials."""
+    out = bytearray()
+    run = bytearray()
+    for b in data:
+        if 32 <= b < 127 or b in (9,):
+            run.append(b)
+        else:
+            if len(run) >= _MIN_PRINTABLE_RUN:
+                out += run
+                out += b"\n"
+            run.clear()
+    if len(run) >= _MIN_PRINTABLE_RUN:
+        out += run
+        out += b"\n"
+    return bytes(out)

@@ -1,0 +1,127 @@
+"""Filesystem walker (ref: pkg/fanal/walker/fs.go, walk.go).
+
+Yields (relative posix path, stat result, opener) for every unfiltered
+regular file under a root. Matches the reference's behavior: default skip
+dirs (``**/.git``, ``proc``, ``sys``, ``dev``), user skip-dirs/files with
+``**``-style glob patterns, a 100 MB size threshold, and tolerance of
+permission errors (logged and skipped, never fatal — ref: fs.go:80-96).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from collections.abc import Callable, Iterator
+from dataclasses import dataclass, field
+
+from trivy_tpu import log
+
+logger = log.logger("walker")
+
+# ref: walk.go:9 — the Go comment says 200MB but the value is 100<<20
+DEFAULT_SIZE_THRESHOLD = 100 << 20
+DEFAULT_SKIP_DIRS = ["**/.git", "proc", "sys", "dev"]  # ref: walk.go:11-16
+
+
+@dataclass
+class WalkOption:
+    skip_files: list[str] = field(default_factory=list)
+    skip_dirs: list[str] = field(default_factory=list)
+    size_threshold: int = DEFAULT_SIZE_THRESHOLD
+
+
+def _glob_to_re(pat: str) -> "re.Pattern":
+    """doublestar-style glob -> regex: ``*``/``?`` never cross ``/``,
+    ``**`` crosses any number of segments (ref: pkg/fanal/utils/utils.go:117
+    uses doublestar.Match — plain fnmatch would over-match and silently
+    drop nested files from the scan)."""
+    out = []
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if c == "*":
+            if pat[i : i + 3] == "**/":
+                out.append("(?:[^/]+/)*")
+                i += 3
+                continue
+            if pat[i : i + 2] == "**":
+                out.append(".*")
+                i += 2
+                continue
+            out.append("[^/]*")
+        elif c == "?":
+            out.append("[^/]")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$")
+
+
+def _match_any(rel: str, patterns: list[str]) -> bool:
+    for pat in patterns:
+        if _glob_to_re(pat.strip("/")).match(rel):
+            return True
+    return False
+
+
+@dataclass
+class FileInfo:
+    """Minimal stat view passed to analyzers' Required()."""
+
+    size: int
+    mode: int
+
+    @classmethod
+    def from_stat(cls, st: os.stat_result) -> "FileInfo":
+        return cls(size=st.st_size, mode=st.st_mode)
+
+
+class FSWalker:
+    """Walk a directory tree, calling back for each eligible file."""
+
+    def __init__(self, option: WalkOption | None = None):
+        self.opt = option or WalkOption()
+
+    def walk(self, root: str) -> Iterator[tuple[str, FileInfo, Callable[[], bytes]]]:
+        root = os.path.abspath(root)
+        skip_dirs = list(self.opt.skip_dirs) + DEFAULT_SKIP_DIRS
+        skip_files = list(self.opt.skip_files)
+        for dirpath, dirnames, filenames in os.walk(root, onerror=self._on_error):
+            rel_dir = os.path.relpath(dirpath, root).replace(os.sep, "/")
+            if rel_dir == ".":
+                rel_dir = ""
+            # prune skipped directories in place
+            kept = []
+            for d in dirnames:
+                rel = f"{rel_dir}/{d}" if rel_dir else d
+                if _match_any(rel, skip_dirs):
+                    continue
+                kept.append(d)
+            dirnames[:] = sorted(kept)
+            for name in sorted(filenames):
+                rel = f"{rel_dir}/{name}" if rel_dir else name
+                if _match_any(rel, skip_files):
+                    continue
+                full = os.path.join(dirpath, name)
+                try:
+                    st = os.lstat(full)
+                except OSError as e:
+                    logger.debug("stat failed, skipping %s: %s", rel, e)
+                    continue
+                # regular files only (no symlinks/devices/sockets)
+                if not os.path.isfile(full) or os.path.islink(full):
+                    continue
+                if st.st_size > self.opt.size_threshold:
+                    logger.debug("file exceeds size threshold, skipping %s", rel)
+                    continue
+
+                def opener(path=full) -> bytes:
+                    with open(path, "rb") as f:
+                        return f.read()
+
+                yield rel, FileInfo.from_stat(st), opener
+
+    @staticmethod
+    def _on_error(err: OSError) -> None:
+        # permission errors are tolerated (ref: fs.go:80-96)
+        logger.debug("walk error tolerated: %s", err)

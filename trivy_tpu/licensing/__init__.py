@@ -1,0 +1,1 @@
+"""License detection and classification (ref: pkg/licensing)."""

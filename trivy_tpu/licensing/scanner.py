@@ -1,0 +1,78 @@
+"""License category/severity mapping (ref: pkg/licensing/scanner.go).
+
+Maps a license name to a risk category (forbidden/restricted/reciprocal/
+notice/permissive/unencumbered/unknown) and severity, honoring
+user-configured category lists (``--license-forbidden`` etc. /
+``license.forbidden`` config keys in the reference).
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.types import DetectedLicense
+
+# Default category assignment for well-known licenses (modeled on the
+# categories the reference inherits from google/licenseclassifier).
+_DEFAULT_CATEGORIES: dict[str, str] = {
+    # forbidden-by-default in the classifier's taxonomy: none — users opt in
+    # restricted
+    "GPL-2.0": "restricted", "GPL-2.0-only": "restricted",
+    "GPL-2.0-or-later": "restricted", "GPL-3.0": "restricted",
+    "GPL-3.0-only": "restricted", "GPL-3.0-or-later": "restricted",
+    "LGPL-2.0": "restricted", "LGPL-2.1": "restricted",
+    "LGPL-2.1-only": "restricted", "LGPL-2.1-or-later": "restricted",
+    "LGPL-3.0": "restricted", "LGPL-3.0-only": "restricted",
+    "LGPL-3.0-or-later": "restricted", "AGPL-1.0": "forbidden",
+    "AGPL-3.0": "forbidden", "AGPL-3.0-only": "forbidden",
+    "AGPL-3.0-or-later": "forbidden",
+    "CC-BY-NC-1.0": "forbidden", "CC-BY-NC-2.0": "forbidden",
+    "CC-BY-NC-3.0": "forbidden", "CC-BY-NC-4.0": "forbidden",
+    "CC-BY-NC-ND-4.0": "forbidden", "CC-BY-NC-SA-4.0": "forbidden",
+    "CC-BY-SA-4.0": "restricted",
+    # reciprocal
+    "MPL-1.0": "reciprocal", "MPL-1.1": "reciprocal", "MPL-2.0": "reciprocal",
+    "EPL-1.0": "reciprocal", "EPL-2.0": "reciprocal",
+    "CDDL-1.0": "reciprocal", "CDDL-1.1": "reciprocal",
+    "EUPL-1.1": "reciprocal", "EUPL-1.2": "reciprocal",
+    "OSL-3.0": "reciprocal", "CPL-1.0": "reciprocal",
+    # notice
+    "Apache-2.0": "notice", "Apache-1.1": "notice", "MIT": "notice",
+    "BSD-2-Clause": "notice", "BSD-3-Clause": "notice", "BSD-4-Clause": "notice",
+    "ISC": "notice", "Zlib": "notice", "PostgreSQL": "notice",
+    "Python-2.0": "notice", "PSF-2.0": "notice", "Ruby": "notice",
+    "PHP-3.01": "notice", "Artistic-2.0": "notice", "OpenSSL": "notice",
+    "NCSA": "notice", "W3C": "notice", "X11": "notice", "BSL-1.0": "notice",
+    "AFL-3.0": "notice", "MS-PL": "notice", "UPL-1.0": "notice",
+    # unencumbered
+    "CC0-1.0": "unencumbered", "Unlicense": "unencumbered", "0BSD": "unencumbered",
+    "WTFPL": "unencumbered",
+}
+
+_CATEGORY_SEVERITY = {
+    "forbidden": "CRITICAL",
+    "restricted": "HIGH",
+    "reciprocal": "MEDIUM",
+    "notice": "LOW",
+    "permissive": "LOW",
+    "unencumbered": "LOW",
+    "unknown": "UNKNOWN",
+}
+
+
+class LicenseCategorizer:
+    """Name -> (category, severity), user config wins (ref: scanner.go)."""
+
+    def __init__(self, user_categories: dict[str, list[str]] | None = None):
+        self.by_name: dict[str, str] = dict(_DEFAULT_CATEGORIES)
+        for category, names in (user_categories or {}).items():
+            for name in names:
+                self.by_name[name] = category
+
+    def detect(self, name: str, pkg_name: str = "", file_path: str = "") -> DetectedLicense:
+        category = self.by_name.get(name, "unknown")
+        return DetectedLicense(
+            name=name,
+            category=category,
+            severity=_CATEGORY_SEVERITY.get(category, "UNKNOWN"),
+            pkg_name=pkg_name,
+            file_path=file_path,
+        )

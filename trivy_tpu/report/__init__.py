@@ -1,0 +1,37 @@
+"""Report writers (ref: pkg/report/writer.go:45-99 format switch)."""
+
+from __future__ import annotations
+
+import sys
+
+from trivy_tpu.types import Report
+
+
+def write(report: Report, fmt: str = "table", output=None, **kw) -> None:
+    out = output or sys.stdout
+    if fmt == "json":
+        from trivy_tpu.report.json_ import write_json
+
+        write_json(report, out, **kw)
+    elif fmt == "table":
+        from trivy_tpu.report.table import write_table
+
+        write_table(report, out, **kw)
+    elif fmt == "sarif":
+        from trivy_tpu.report.sarif import write_sarif
+
+        write_sarif(report, out, **kw)
+    elif fmt in ("cyclonedx", "spdx", "spdx-json"):
+        from trivy_tpu.sbom.io import encode_report
+
+        encode_report(report, fmt, out, **kw)
+    elif fmt == "github":
+        from trivy_tpu.report.github import write_github
+
+        write_github(report, out, **kw)
+    elif fmt == "template":
+        from trivy_tpu.report.template import write_template
+
+        write_template(report, out, **kw)
+    else:
+        raise ValueError(f"unknown format: {fmt}")

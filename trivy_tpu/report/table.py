@@ -1,0 +1,129 @@
+"""Table report writer (ref: pkg/report/table).
+
+Per-class renderers: vulnerabilities/misconfigurations in a summary table,
+secrets with their censored code context blocks — matching the reference's
+terminal layout closely enough to be familiar.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from trivy_tpu.types import Report, Result
+
+SEV_ORDER = ["CRITICAL", "HIGH", "MEDIUM", "LOW", "UNKNOWN"]
+
+
+def _rule(width: int) -> str:
+    return "─" * width
+
+
+def _severity_summary(counter: Counter) -> str:
+    parts = [f"{s}: {counter.get(s, 0)}" for s in SEV_ORDER if counter.get(s, 0)]
+    return ", ".join(parts) if parts else "none"
+
+
+def write_table(report: Report, out, **_kw) -> None:
+    if not report.results:
+        out.write(f"\n{report.artifact_name} ({report.artifact_type})\n")
+        out.write("No issues detected.\n")
+        return
+    for result in report.results:
+        _write_result(result, out)
+
+
+def _header(out, title: str, extra: str = "") -> None:
+    out.write(f"\n{title}{(' ' + extra) if extra else ''}\n")
+    out.write(_rule(max(20, len(title) + len(extra) + 1)) + "\n")
+
+
+def _write_result(result: Result, out) -> None:
+    if result.vulnerabilities:
+        counter = Counter(v.severity for v in result.vulnerabilities)
+        _header(
+            out,
+            f"{result.target} ({result.type})",
+            f"— {len(result.vulnerabilities)} vulnerabilities ({_severity_summary(counter)})",
+        )
+        cols = ["Library", "Vulnerability", "Severity", "Installed", "Fixed", "Title"]
+        rows = [
+            [
+                v.pkg_name,
+                v.vulnerability_id,
+                v.severity,
+                v.installed_version,
+                v.fixed_version or "—",
+                (v.title or "")[:60],
+            ]
+            for v in result.vulnerabilities
+        ]
+        _grid(out, cols, rows)
+    if result.secrets:
+        counter = Counter(s.severity for s in result.secrets)
+        _header(
+            out,
+            result.target,
+            f"— {len(result.secrets)} secrets ({_severity_summary(counter)})",
+        )
+        for s in result.secrets:
+            out.write(f"\n{s.severity}: {s.title} ({s.rule_id})\n")
+            loc = (
+                f"line {s.start_line}"
+                if s.start_line == s.end_line
+                else f"lines {s.start_line}-{s.end_line}"
+            )
+            out.write(f"{_rule(40)}\n{result.target}:{loc}\n")
+            for line in s.code.lines:
+                marker = ">" if line.is_cause else " "
+                out.write(f"{line.number:>4} {marker} {line.content}\n")
+            out.write(_rule(40) + "\n")
+    if result.misconfigurations:
+        counter = Counter(m.severity for m in result.misconfigurations)
+        fails = [m for m in result.misconfigurations if m.status == "FAIL"]
+        _header(
+            out,
+            f"{result.target} ({result.type})",
+            f"— {len(fails)} failures ({_severity_summary(counter)})",
+        )
+        for m in fails:
+            out.write(f"\n{m.severity}: {m.id} — {m.title}\n")
+            if m.message:
+                out.write(f"  {m.message}\n")
+            if m.start_line:
+                out.write(f"  at {result.target}:{m.start_line}\n")
+    if result.licenses:
+        counter = Counter(l.severity for l in result.licenses)
+        _header(
+            out,
+            f"{result.target} (license)",
+            f"— {len(result.licenses)} findings ({_severity_summary(counter)})",
+        )
+        cols = ["Package/File", "License", "Category", "Severity"]
+        rows = [
+            [l.pkg_name or l.file_path, l.name, l.category, l.severity]
+            for l in result.licenses
+        ]
+        _grid(out, cols, rows)
+    if result.packages and not (
+        result.vulnerabilities or result.secrets or result.licenses
+    ):
+        _header(out, f"{result.target} ({result.type})", f"— {len(result.packages)} packages")
+        cols = ["Package", "Version"]
+        rows = [[p.name, p.version] for p in result.packages]
+        _grid(out, cols, rows)
+
+
+def _grid(out, cols: list[str], rows: list[list[str]]) -> None:
+    widths = [len(c) for c in cols]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(str(cell)))
+    line = "┼".join(_rule(w + 2) for w in widths)
+
+    def fmt(cells):
+        return "│".join(f" {str(c):<{widths[i]}} " for i, c in enumerate(cells))
+
+    out.write(fmt(cols) + "\n")
+    out.write(line + "\n")
+    for row in rows:
+        out.write(fmt(row) + "\n")

@@ -1,0 +1,157 @@
+"""Result filtering (ref: pkg/result/filter.go).
+
+Severity filter, ``.trivyignore`` / YAML ignore files with expiry, and
+deterministic dedup+sort — applied after scanning, before reporting
+(ref: filter.go:37-120).
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from dataclasses import dataclass, field
+
+from trivy_tpu import log
+from trivy_tpu.types import Report
+
+logger = log.logger("result")
+
+
+@dataclass
+class IgnoreEntry:
+    id: str
+    paths: list[str] = field(default_factory=list)
+    expired_at: datetime.date | None = None
+    statement: str = ""
+
+    def active(self, today: datetime.date) -> bool:
+        return self.expired_at is None or today <= self.expired_at
+
+
+@dataclass
+class IgnoreConfig:
+    vulnerabilities: list[IgnoreEntry] = field(default_factory=list)
+    misconfigurations: list[IgnoreEntry] = field(default_factory=list)
+    secrets: list[IgnoreEntry] = field(default_factory=list)
+    licenses: list[IgnoreEntry] = field(default_factory=list)
+
+    @classmethod
+    def load(cls, path: str | None) -> "IgnoreConfig":
+        cfg = cls()
+        if not path or not os.path.exists(path):
+            return cfg
+        if path.endswith((".yml", ".yaml")):
+            import yaml
+
+            with open(path) as f:
+                data = yaml.safe_load(f) or {}
+
+            def entries(key):
+                out = []
+                for e in data.get(key, []) or []:
+                    exp = e.get("expired_at")
+                    if isinstance(exp, str):
+                        exp = datetime.date.fromisoformat(exp)
+                    out.append(
+                        IgnoreEntry(
+                            id=e.get("id", ""),
+                            paths=list(e.get("paths", []) or []),
+                            expired_at=exp,
+                            statement=e.get("statement", ""),
+                        )
+                    )
+                return out
+
+            cfg.vulnerabilities = entries("vulnerabilities")
+            cfg.misconfigurations = entries("misconfigurations")
+            cfg.secrets = entries("secrets")
+            cfg.licenses = entries("licenses")
+            return cfg
+        # plain .trivyignore: one ID per line, '#' comments (ref:
+        # result/filter.go parseIgnoreFile)
+        ids = []
+        with open(path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if line:
+                    ids.append(IgnoreEntry(id=line))
+        cfg.vulnerabilities = ids
+        cfg.secrets = list(ids)
+        cfg.misconfigurations = list(ids)
+        cfg.licenses = list(ids)
+        return cfg
+
+    def match(self, entries: list[IgnoreEntry], id_: str, path: str = "") -> bool:
+        import fnmatch
+
+        today = datetime.date.today()
+        for e in entries:
+            if not e.active(today):
+                continue
+            if e.id and e.id != id_:
+                continue
+            if e.paths and not any(fnmatch.fnmatch(path, p) for p in e.paths):
+                continue
+            return True
+        return False
+
+
+@dataclass
+class FilterOptions:
+    severities: list[str] = field(default_factory=list)
+    ignore_file: str | None = None
+    include_non_failures: bool = False
+    vex_sources: list[str] = field(default_factory=list)
+
+
+def filter_report(report: Report, options: FilterOptions) -> Report:
+    """In-place severity/ignore filtering + dedup (ref: filter.go:37)."""
+    ignores = IgnoreConfig.load(options.ignore_file)
+    sevs = set(options.severities)
+
+    for result in report.results:
+        if sevs:
+            result.vulnerabilities = [
+                v for v in result.vulnerabilities if v.severity in sevs
+            ]
+            result.secrets = [s for s in result.secrets if s.severity in sevs]
+            result.misconfigurations = [
+                m for m in result.misconfigurations if m.severity in sevs
+            ]
+            result.licenses = [l for l in result.licenses if l.severity in sevs]
+        result.vulnerabilities = [
+            v
+            for v in result.vulnerabilities
+            if not ignores.match(
+                ignores.vulnerabilities, v.vulnerability_id, v.pkg_path or v.pkg_name
+            )
+        ]
+        result.secrets = [
+            s
+            for s in result.secrets
+            if not ignores.match(ignores.secrets, s.rule_id, result.target)
+        ]
+        result.misconfigurations = [
+            m
+            for m in result.misconfigurations
+            if not ignores.match(ignores.misconfigurations, m.id, result.target)
+        ]
+        result.licenses = [
+            l
+            for l in result.licenses
+            if not ignores.match(ignores.licenses, l.name, l.file_path or l.pkg_name)
+        ]
+        # dedup + deterministic order (ref: filter.go:77-120)
+        seen = set()
+        uniq = []
+        for v in sorted(
+            result.vulnerabilities,
+            key=lambda v: (v.pkg_name, v.vulnerability_id, v.pkg_path, v.fixed_version),
+        ):
+            key = (v.vulnerability_id, v.pkg_name, v.pkg_path, v.installed_version)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(v)
+        result.vulnerabilities = uniq
+    report.results = [r for r in report.results if not r.is_empty]
+    return report

@@ -1,0 +1,51 @@
+"""Scanner facade binding an Artifact to a scan Driver
+(ref: pkg/scanner/scan.go:134-204)."""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+
+from trivy_tpu.types import Report, Result
+
+
+@dataclass
+class ScanOptions:
+    """What to scan and how (ref: pkg/types ScanOptions)."""
+
+    scanners: list[str] = field(default_factory=lambda: ["vuln", "secret"])
+    license_categories: dict[str, list[str]] = field(default_factory=dict)
+    license_full: bool = False
+    include_dev_deps: bool = False
+    pkg_types: list[str] = field(default_factory=lambda: ["os", "library"])
+    detection_priority: str = "precise"
+
+
+class Scanner:
+    """Artifact + Driver (local or remote client), ref: scan.go:134-152."""
+
+    def __init__(self, artifact, driver):
+        self.artifact = artifact
+        self.driver = driver
+
+    def scan_artifact(self, options: ScanOptions) -> Report:
+        ref = self.artifact.inspect()
+        results, os_info = self.driver.scan(ref.name, ref.id, ref.blob_ids, options)
+        metadata = {
+            "ImageID": ref.image_metadata.get("id", ""),
+            "DiffIDs": ref.image_metadata.get("diff_ids", []),
+        }
+        if os_info is not None:
+            metadata["OS"] = os_info.to_dict()
+        if ref.image_metadata.get("config"):
+            metadata["ImageConfig"] = ref.image_metadata["config"]
+        return Report(
+            created_at=datetime.datetime.now(datetime.timezone.utc).isoformat(),
+            artifact_name=ref.name,
+            artifact_type=ref.type,
+            metadata=metadata,
+            results=[r for r in results if not r.is_empty],
+        )
+
+
+__all__ = ["Scanner", "ScanOptions", "Result"]

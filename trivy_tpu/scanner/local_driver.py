@@ -1,0 +1,133 @@
+"""Local scan driver (ref: pkg/scanner/local/scan.go).
+
+Consumes cache keys only: applies layers, then assembles per-class results
+— vulnerabilities via the detectors, plus misconfig/secret/license sections
+(ref: scan.go:63-151, 229-318).
+"""
+
+from __future__ import annotations
+
+from trivy_tpu import log
+from trivy_tpu.fanal.applier import apply_layers
+from trivy_tpu.scanner import ScanOptions
+from trivy_tpu.types import (
+    BlobInfo,
+    DetectedLicense,
+    OS,
+    Result,
+    ResultClass,
+    Secret,
+)
+
+logger = log.logger("scanner:local")
+
+
+class LocalDriver:
+    def __init__(self, cache, vuln_client=None):
+        self.cache = cache
+        self.vuln_client = vuln_client
+
+    def scan(
+        self, target: str, artifact_id: str, blob_ids: list[str], options: ScanOptions
+    ) -> tuple[list[Result], OS | None]:
+        blobs = []
+        for bid in blob_ids:
+            d = self.cache.get_blob(bid)
+            if d is None:
+                raise KeyError(f"blob missing from cache: {bid}")
+            blobs.append(BlobInfo.from_dict(d))
+        detail = apply_layers(blobs)
+        results: list[Result] = []
+
+        if "vuln" in options.scanners:
+            results.extend(self._scan_vulnerabilities(target, detail, options))
+        if "misconfig" in options.scanners:
+            results.extend(self._misconfig_results(target, detail))
+        if "secret" in options.scanners:
+            results.extend(self._secret_results(detail))
+        if "license" in options.scanners:
+            results.extend(self._license_results(target, detail, options))
+        return results, detail.os
+
+    # -- per-class assembly (ref: scan.go:153-318) --------------------------
+
+    def _scan_vulnerabilities(self, target, detail, options):
+        results: list[Result] = []
+        if self.vuln_client is None:
+            return results
+        from trivy_tpu.detector import detect_all
+
+        return detect_all(self.vuln_client, target, detail, options)
+
+    def _secret_results(self, detail) -> list[Result]:
+        out = []
+        for secret in detail.secrets:
+            assert isinstance(secret, Secret)
+            out.append(
+                Result(
+                    target=secret.file_path,
+                    cls=ResultClass.SECRET.value,
+                    secrets=secret.findings,
+                )
+            )
+        return out
+
+    def _misconfig_results(self, target, detail) -> list[Result]:
+        out = []
+        for mc in detail.misconfigurations:
+            out.append(
+                Result(
+                    target=mc.file_path,
+                    cls=ResultClass.CONFIG.value,
+                    type=mc.file_type,
+                    misconfigurations=mc.successes + mc.failures,
+                )
+            )
+        return out
+
+    def _license_results(self, target, detail, options) -> list[Result]:
+        from trivy_tpu.licensing.scanner import LicenseCategorizer
+
+        cat = LicenseCategorizer(options.license_categories)
+        os_lics: list[DetectedLicense] = []
+        file_lics: list[DetectedLicense] = []
+        for pkg in detail.packages:
+            for name in pkg.licenses:
+                os_lics.append(cat.detect(name, pkg_name=pkg.name))
+        for lf in detail.licenses:
+            for f in lf.findings:
+                d = cat.detect(f.name, file_path=lf.file_path)
+                d.confidence = f.confidence
+                d.link = f.link
+                file_lics.append(d)
+        results = []
+        if os_lics:
+            results.append(
+                Result(
+                    target="OS Packages",
+                    cls=ResultClass.LICENSE.value,
+                    licenses=os_lics,
+                )
+            )
+        app_lics: list[DetectedLicense] = []
+        for app in detail.applications:
+            for pkg in app.packages:
+                for name in pkg.licenses:
+                    app_lics.append(cat.detect(name, pkg_name=pkg.name))
+        if app_lics:
+            results.append(
+                Result(
+                    target="Language Packages",
+                    cls=ResultClass.LICENSE.value,
+                    licenses=app_lics,
+                )
+            )
+        if file_lics:
+            results.append(
+                Result(
+                    target="Loose File License(s)",
+                    cls=ResultClass.LICENSE_FILE.value,
+                    licenses=file_lics,
+                )
+            )
+        return results
