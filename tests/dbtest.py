@@ -1,0 +1,74 @@
+"""Fixture advisory DB builder (mirrors the reference's fake-DB pattern,
+ref: internal/dbtest/db.go — a real DB built from fixtures into tmpdir)."""
+
+import json
+from pathlib import Path
+
+ADVISORIES = {
+    "alpine 3.18": {
+        "musl": [
+            {"VulnerabilityID": "CVE-2023-0001", "FixedVersion": "1.2.4-r1"},
+        ],
+        "busybox": [
+            {"VulnerabilityID": "CVE-2023-0002", "FixedVersion": "1.36.1-r1"},
+            {"VulnerabilityID": "CVE-2023-0003", "FixedVersion": ""},
+        ],
+    },
+    "debian 12": {
+        "openssl": [
+            {"VulnerabilityID": "CVE-2023-1111", "FixedVersion": "3.0.11-1~deb12u1"},
+        ],
+    },
+    "npm::GitHub Security Advisory npm": {
+        "lodash": [
+            {
+                "VulnerabilityID": "CVE-2021-23337",
+                "VulnerableVersions": ["<4.17.21"],
+                "PatchedVersions": ["4.17.21"],
+            },
+        ],
+        "minimist": [
+            {
+                "VulnerabilityID": "CVE-2020-7598",
+                "VulnerableVersions": ["<0.2.1", ">=1.0.0, <1.2.3"],
+                "PatchedVersions": ["0.2.1", "1.2.3"],
+            },
+        ],
+    },
+    "pip::GitHub Security Advisory pip": {
+        "django": [
+            {
+                "VulnerabilityID": "CVE-2023-2222",
+                "VulnerableVersions": [">=4.0, <4.1.9"],
+                "PatchedVersions": ["4.1.9"],
+            },
+        ],
+    },
+}
+
+DETAILS = {
+    "CVE-2023-0001": {"Title": "musl: buffer overflow", "Severity": "HIGH"},
+    "CVE-2023-0002": {
+        "Title": "busybox bug",
+        "VendorSeverity": {"nvd": 2, "alpine": 3},
+    },
+    "CVE-2023-0003": {"Title": "busybox unfixed", "Severity": "LOW"},
+    "CVE-2023-1111": {"Title": "openssl issue", "Severity": "CRITICAL"},
+    "CVE-2021-23337": {
+        "Title": "lodash command injection",
+        "Severity": "HIGH",
+        "CweIDs": ["CWE-77"],
+        "References": ["https://example.com/lodash"],
+    },
+    "CVE-2020-7598": {"Title": "minimist prototype pollution", "Severity": "MEDIUM"},
+    "CVE-2023-2222": {"Title": "django bug", "Severity": "HIGH"},
+}
+
+
+def build_db(tmpdir) -> str:
+    d = Path(tmpdir) / "db"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "metadata.json").write_text(json.dumps({"Version": 2}))
+    (d / "advisories.json").write_text(json.dumps(ADVISORIES))
+    (d / "vulnerability.json").write_text(json.dumps(DETAILS))
+    return str(d)

@@ -1,0 +1,114 @@
+"""Detection layer: ospkg + library drivers against a fixture DB."""
+
+import json
+
+import pytest
+
+from tests.dbtest import build_db
+from trivy_tpu.db import VulnDB
+from trivy_tpu.detector import library, ospkg
+from trivy_tpu.types import Application, OS, Package
+from trivy_tpu.vulnerability import fill_infos
+
+
+@pytest.fixture
+def db(tmp_path):
+    return VulnDB.load(build_db(tmp_path))
+
+
+def test_ospkg_alpine(db):
+    os_info = OS(family="alpine", name="3.18")
+    pkgs = [
+        Package(name="musl", version="1.2.3", release="r0"),
+        Package(name="busybox", version="1.36.1", release="r0"),
+        Package(name="zlib", version="1.3", release="r0"),
+    ]
+    vulns = ospkg.detect(db, os_info, pkgs)
+    by_id = {v.vulnerability_id: v for v in vulns}
+    # musl 1.2.3-r0 < 1.2.4-r1 -> vulnerable
+    assert "CVE-2023-0001" in by_id
+    assert by_id["CVE-2023-0001"].fixed_version == "1.2.4-r1"
+    # busybox: fixed advisory 1.36.1-r1 (vulnerable at r0) + unfixed advisory
+    assert "CVE-2023-0002" in by_id
+    assert by_id["CVE-2023-0003"].status == "affected"
+    assert "zlib" not in {v.pkg_name for v in vulns}
+
+
+def test_ospkg_fixed_version_not_vulnerable(db):
+    os_info = OS(family="alpine", name="3.18")
+    pkgs = [Package(name="musl", version="1.2.4", release="r1")]
+    vulns = ospkg.detect(db, os_info, pkgs)
+    assert [v.vulnerability_id for v in vulns] == []
+
+
+def test_ospkg_debian_epoch(db):
+    os_info = OS(family="debian", name="12.4")  # bucket keyed by major
+    pkgs = [Package(name="openssl", version="3.0.9", release="1")]
+    vulns = ospkg.detect(db, os_info, pkgs)
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2023-1111"]
+
+
+def test_library_npm(db):
+    app = Application(
+        type="npm",
+        file_path="package-lock.json",
+        packages=[
+            Package(name="lodash", version="4.17.20"),
+            Package(name="lodash", version="4.17.21"),
+            Package(name="minimist", version="1.2.0"),
+            Package(name="minimist", version="0.2.4"),
+        ],
+    )
+    vulns = library.detect(db, app)
+    got = {(v.pkg_name, v.installed_version): v for v in vulns}
+    assert ("lodash", "4.17.20") in got
+    assert got[("lodash", "4.17.20")].fixed_version == "4.17.21"
+    assert ("lodash", "4.17.21") not in got
+    assert ("minimist", "1.2.0") in got  # in >=1.0.0,<1.2.3 range
+    assert ("minimist", "0.2.4") not in got  # between the two ranges
+
+
+def test_fill_infos(db):
+    app = Application(type="npm", packages=[Package(name="lodash", version="4.0.0")])
+    vulns = library.detect(db, app)
+    fill_infos(db, vulns)
+    v = vulns[0]
+    assert v.title == "lodash command injection"
+    assert v.severity == "HIGH"
+    assert v.cwe_ids == ["CWE-77"]
+    assert v.primary_url.endswith("cve-2021-23337")
+
+
+def test_vendor_severity_priority(db):
+    os_info = OS(family="alpine", name="3.18")
+    vulns = ospkg.detect(db, os_info, [Package(name="busybox", version="1.0", release="r0")])
+    fill_infos(db, vulns)
+    v = {x.vulnerability_id: x for x in vulns}["CVE-2023-0002"]
+    assert v.severity == "MEDIUM"  # nvd rank 2, preferred over alpine
+    assert v.severity_source == "nvd"
+
+
+def test_batch_detect_parity(db, monkeypatch):
+    """Device-batched constraint evaluation == host evaluation on a large
+    synthetic npm application (the 50k-package SBOM path)."""
+    import random
+
+    from trivy_tpu.detector import library as lib
+
+    rng = random.Random(9)
+    pkgs = []
+    for i in range(1200):
+        name = rng.choice(["lodash", "minimist", "other-pkg"])
+        ver = f"{rng.randint(0,4)}.{rng.randint(0,20)}.{rng.randint(0,25)}"
+        pkgs.append(Package(name=name, version=ver, id=f"p{i}"))
+    app = Application(type="npm", file_path="package-lock.json", packages=pkgs)
+
+    batched = lib.detect(db, app)  # >= BATCH_THRESHOLD -> device path
+    monkeypatch.setattr(lib, "BATCH_THRESHOLD", 10**9)
+    host = lib.detect(db, app)
+    key = lambda v: (v.pkg_id, v.vulnerability_id)
+    assert sorted(map(key, batched)) == sorted(map(key, host))
+    assert {(v.pkg_id, v.fixed_version) for v in batched} == {
+        (v.pkg_id, v.fixed_version) for v in host
+    }
+    assert len(batched) > 0

@@ -1,0 +1,102 @@
+"""SBOM decode + CVE-match path, library and CLI surfaces."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.dbtest import build_db
+
+CDX = {
+    "bomFormat": "CycloneDX",
+    "specVersion": "1.5",
+    "components": [
+        {"type": "library", "name": "lodash", "version": "4.17.20",
+         "purl": "pkg:npm/lodash@4.17.20"},
+        {"type": "library", "name": "minimist", "version": "1.2.0",
+         "purl": "pkg:npm/minimist@1.2.0"},
+        {"type": "library", "name": "django", "version": "4.1.5",
+         "purl": "pkg:pypi/django@4.1.5",
+         "licenses": [{"license": {"id": "BSD-3-Clause"}}]},
+        {"type": "library", "name": "musl", "version": "1.2.3-r0",
+         "purl": "pkg:apk/alpine/musl@1.2.3-r0?distro=alpine-3.18"},
+        {"type": "operating-system", "name": "alpine", "version": "3.18"},
+    ],
+}
+
+
+def test_decode_cyclonedx():
+    from trivy_tpu.sbom.decode import decode
+
+    blob = decode(json.dumps(CDX).encode())
+    assert blob.os.family == "alpine" and blob.os.name == "3.18"
+    apps = {a.type: a for a in blob.applications}
+    assert "node-pkg" in apps and "python-pkg" in apps
+    assert {p.name for p in apps["node-pkg"].packages} == {"lodash", "minimist"}
+    assert apps["python-pkg"].packages[0].licenses == ["BSD-3-Clause"]
+    assert blob.package_infos[0].packages[0].name == "musl"
+
+
+def test_decode_spdx_json():
+    from trivy_tpu.sbom.decode import decode
+
+    doc = {
+        "spdxVersion": "SPDX-2.3",
+        "packages": [
+            {
+                "name": "lodash",
+                "versionInfo": "4.17.20",
+                "licenseConcluded": "MIT",
+                "externalRefs": [
+                    {"referenceType": "purl",
+                     "referenceLocator": "pkg:npm/lodash@4.17.20"}
+                ],
+            }
+        ],
+    }
+    blob = decode(json.dumps(doc).encode())
+    assert blob.applications[0].packages[0].name == "lodash"
+    assert blob.applications[0].packages[0].licenses == ["MIT"]
+
+
+def test_purl_roundtrip():
+    from trivy_tpu.purl import PackageURL
+
+    for s in [
+        "pkg:npm/lodash@4.17.20",
+        "pkg:npm/%40babel/core@7.0.0",
+        "pkg:maven/org.apache/commons-text@1.9",
+        "pkg:apk/alpine/musl@1.2.3-r0?arch=x86_64&distro=alpine-3.18",
+    ]:
+        p = PackageURL.parse(s)
+        assert PackageURL.parse(p.to_string()).to_string() == p.to_string()
+    p = PackageURL.parse("pkg:npm/%40babel/core@7.0.0")
+    assert p.namespace == "@babel" and p.name == "core"
+
+
+def test_sbom_cli_scan(tmp_path):
+    db_dir = build_db(tmp_path)
+    sbom_path = tmp_path / "bom.json"
+    sbom_path.write_text(json.dumps(CDX))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", "sbom", "--format", "json",
+         "--db-repository", db_dir, "--cache-dir", str(tmp_path / "cache"),
+         str(sbom_path)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    vulns = {
+        v["VulnerabilityID"]: v
+        for r in doc["Results"]
+        for v in r.get("Vulnerabilities", [])
+    }
+    assert "CVE-2021-23337" in vulns          # lodash 4.17.20
+    assert vulns["CVE-2021-23337"]["FixedVersion"] == "4.17.21"
+    assert vulns["CVE-2021-23337"]["Severity"] == "HIGH"
+    assert "CVE-2020-7598" in vulns           # minimist 1.2.0
+    assert "CVE-2023-2222" in vulns           # django 4.1.5
+    assert "CVE-2023-0001" in vulns           # musl via OS packages

@@ -1,0 +1,82 @@
+"""Property tests: encoded lexicographic order == exact Python comparers."""
+
+import random
+
+import numpy as np
+import pytest
+
+from trivy_tpu.ops.verscmp import batch_compare
+from trivy_tpu.version import compare
+from trivy_tpu.version.encode import ENCODABLE, encode
+
+
+def _random_versions(scheme: str, rng: random.Random, n: int) -> list[str]:
+    out = []
+    for _ in range(n):
+        if scheme == "deb":
+            v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 3)))
+            if rng.random() < 0.3:
+                v += rng.choice(["~rc1", "~beta", "+dfsg", "a", "b", "~~", ".10"])
+            if rng.random() < 0.4:
+                v = f"{rng.randint(0, 2)}:{v}"
+            if rng.random() < 0.5:
+                v += f"-{rng.randint(0, 5)}"
+                if rng.random() < 0.2:
+                    v += rng.choice(["ubuntu1", "~deb12u1", "+b2"])
+        elif scheme == "rpm":
+            v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 4)))
+            if rng.random() < 0.3:
+                v += rng.choice(["~rc1", "^git123", ".a", "a", ".post"])
+            if rng.random() < 0.4:
+                v = f"{rng.randint(0, 2)}:{v}"
+            if rng.random() < 0.5:
+                v += f"-{rng.randint(1, 30)}.el{rng.randint(7, 9)}"
+        elif scheme == "apk":
+            v = ".".join(str(rng.randint(0, 20)) for _ in range(rng.randint(1, 3)))
+            if rng.random() < 0.2:
+                v += rng.choice("abc")
+            if rng.random() < 0.3:
+                v += rng.choice(["_alpha", "_beta2", "_rc1", "_p1", "_git2021"])
+            if rng.random() < 0.6:
+                v += f"-r{rng.randint(0, 10)}"
+        else:  # semver / npm
+            v = ".".join(str(rng.randint(0, 20)) for _ in range(3))
+            if rng.random() < 0.3:
+                v += "-" + rng.choice(
+                    ["alpha", "alpha.1", "beta.2", "rc.1", "1", "alpha.beta", "x.7.z"]
+                )
+            if rng.random() < 0.1:
+                v += "+build.5"
+        out.append(v)
+    return out
+
+
+@pytest.mark.parametrize("scheme", sorted(ENCODABLE))
+def test_encoded_order_matches_python(scheme):
+    rng = random.Random(hash(scheme) & 0xFFFF)
+    versions = _random_versions(scheme, rng, 120)
+    pairs = [
+        (rng.choice(versions), rng.choice(versions)) for _ in range(400)
+    ] + [(v, v) for v in versions[:20]]
+    want = np.array([compare(scheme, a, b) for a, b in pairs], dtype=np.int32)
+    got = batch_compare(scheme, pairs)
+    assert got is not None
+    mism = np.nonzero(got != want)[0]
+    detail = [(pairs[i], int(want[i]), int(got[i])) for i in mism[:5]]
+    assert len(mism) == 0, f"{scheme}: {len(mism)} mismatches, e.g. {detail}"
+
+
+def test_fixture_versions_encode(request):
+    """Every fixture version from test_version.py round-trips the device."""
+    from tests.test_version import CASES
+
+    for scheme, a, b, want in CASES:
+        if scheme not in ENCODABLE:
+            continue
+        got = batch_compare(scheme, [(a, b)])
+        assert got is not None and got[0] == want, (scheme, a, b, want, got)
+
+
+def test_unencodable_scheme_returns_none():
+    assert encode("maven", "1.0") is None
+    assert batch_compare("maven", [("1.0", "2.0")]) is None

@@ -1,0 +1,150 @@
+"""Version-comparison fixtures per ecosystem (ref: the reference ports its
+comparer test fixtures first — SURVEY.md §7 hard part (e))."""
+
+import pytest
+
+from trivy_tpu.version import compare, satisfies
+
+# (scheme, a, b, expected sign)
+CASES = [
+    # --- dpkg/deb: deb-version(7) semantics
+    ("deb", "1.0", "1.0", 0),
+    ("deb", "1.0", "2.0", -1),
+    ("deb", "2.0", "1.0", 1),
+    ("deb", "1:1.0", "2.0", 1),          # epoch wins
+    ("deb", "0:1.0", "1.0", 0),
+    ("deb", "1.0-1", "1.0-2", -1),       # revision compare
+    ("deb", "1.0", "1.0-1", -1),         # empty revision < any revision
+    ("deb", "1.2~rc1", "1.2", -1),       # tilde sorts before release
+    ("deb", "1.2~rc1", "1.2~rc2", -1),
+    ("deb", "1.2~~", "1.2~", -1),        # double tilde before single
+    ("deb", "1.2a", "1.2", 1),           # letter after digits > end
+    ("deb", "1.2a", "1.2b", -1),
+    ("deb", "1.2+dfsg", "1.2", 1),
+    ("deb", "1.10", "1.9", 1),           # numeric, not lexicographic
+    ("deb", "1.09", "1.9", 0),           # leading zeros equal
+    ("deb", "7.6p2-4", "7.6-0", 1),
+    ("deb", "1.0.5+really1.0.4", "1.0.5", 1),
+    ("deb", "2.2.3.dfsg.1-2", "2.2.3.dfsg.1-1", 1),
+    ("deb", "1.18.36:5.4", "1.18.36:5.5", -1),  # colon without digit epoch
+    # --- rpm: rpmvercmp
+    ("rpm", "1.0", "1.0", 0),
+    ("rpm", "1.0", "2.0", -1),
+    ("rpm", "2.0.1", "2.0.1", 0),
+    ("rpm", "2.0", "2.0.1", -1),
+    ("rpm", "1:1.0", "2.0", 1),          # epoch
+    ("rpm", "5.16.1.3-1", "5.16.0.3-1", 1),
+    ("rpm", "1.0-1", "1.0-2", -1),
+    ("rpm", "1.0~rc1", "1.0", -1),       # tilde pre-release
+    ("rpm", "1.0~rc1", "1.0~rc2", -1),
+    ("rpm", "1.0^git1", "1.0", 1),       # caret post-release
+    ("rpm", "1.0^git1", "1.0.1", -1),    # but before further segments
+    ("rpm", "1.0a", "1.0", 1),           # extra trailing segment is newer
+    ("rpm", "1.0.a", "1.0", 1),
+    ("rpm", "abc", "abd", -1),
+    ("rpm", "12", "3", 1),               # numeric compare
+    ("rpm", "1a", "1b", -1),
+    ("rpm", "a1", "1", -1),              # number beats letter at first segment
+    # --- apk
+    ("apk", "1.2.3", "1.2.3", 0),
+    ("apk", "1.2.3", "1.2.4", -1),
+    ("apk", "1.2.3-r0", "1.2.3-r1", -1),
+    ("apk", "1.2.3_alpha", "1.2.3", -1),
+    ("apk", "1.2.3_alpha1", "1.2.3_alpha2", -1),
+    ("apk", "1.2.3_rc1", "1.2.3_beta1", 1),
+    ("apk", "1.2.3_p1", "1.2.3", 1),     # patch suffix after release
+    ("apk", "1.2.3a", "1.2.3", 1),
+    ("apk", "1.2.3a", "1.2.3b", -1),
+    ("apk", "1.10", "1.9", 1),
+    # --- semver / npm
+    ("semver", "1.2.3", "1.2.3", 0),
+    ("semver", "1.2.3", "1.2.4", -1),
+    ("semver", "v1.2.3", "1.2.3", 0),
+    ("semver", "1.2.3-alpha", "1.2.3", -1),
+    ("semver", "1.2.3-alpha.1", "1.2.3-alpha.2", -1),
+    ("semver", "1.2.3-alpha.9", "1.2.3-alpha.10", -1),  # numeric ids
+    ("semver", "1.2.3-1", "1.2.3-alpha", -1),           # numeric < alpha
+    ("semver", "1.2.3-alpha", "1.2.3-alpha.1", -1),     # shorter < longer
+    ("semver", "1.0", "1.0.0", 0),
+    ("semver", "1.2.3+build5", "1.2.3+build9", 0),      # build ignored
+    ("semver", "10.0.0", "9.0.0", 1),
+    # --- pep440
+    ("pep440", "1.0", "1.0.0", 0),
+    ("pep440", "1.0a1", "1.0", -1),
+    ("pep440", "1.0.post1", "1.0", 1),
+    ("pep440", "1.0.dev1", "1.0a1", -1),
+    ("pep440", "1.0rc1", "1.0", -1),
+    ("pep440", "2!1.0", "10.0", 1),      # epoch
+    ("pep440", "1.0+local", "1.0", 1),
+    # --- maven
+    ("maven", "1.0", "1.0.0", 0),
+    ("maven", "1.0", "1.1", -1),
+    ("maven", "1.0-alpha-1", "1.0", -1),
+    ("maven", "1.0-beta-1", "1.0-alpha-1", 1),
+    ("maven", "1.0-rc1", "1.0-beta-1", 1),
+    ("maven", "1.0-SNAPSHOT", "1.0", -1),
+    ("maven", "1.0-sp1", "1.0", 1),
+    ("maven", "1.0-RELEASE", "1.0", 0),
+    ("maven", "1.0-FINAL", "1.0", 0),
+    ("maven", "1.0-xyz", "1.0", 1),      # unknown qualifier after release
+    ("maven", "1.0.1", "1.0-sp1", 1),
+    # --- rubygems
+    ("gem", "1.0.0", "1.0", 0),
+    ("gem", "1.0.0", "1.0.1", -1),
+    ("gem", "1.0.0.pre", "1.0.0", -1),
+    ("gem", "1.0.0-alpha", "1.0.0", -1),
+    ("gem", "1.0.0.beta2", "1.0.0.beta10", -1),
+    ("gem", "1.0.0.a", "1.0.0.b", -1),
+]
+
+
+@pytest.mark.parametrize("scheme,a,b,want", CASES)
+def test_compare(scheme, a, b, want):
+    got = compare(scheme, a, b)
+    assert got == want, f"{scheme}: {a} vs {b}: got {got}, want {want}"
+    assert compare(scheme, b, a) == -want  # antisymmetry
+
+
+CONSTRAINT_CASES = [
+    ("semver", "1.2.3", ">=1.0.0, <2.0.0", True),
+    ("semver", "2.0.0", ">=1.0.0, <2.0.0", False),
+    ("semver", "0.9", ">=1.0.0 || <0.5", False),
+    ("semver", "0.4", ">=1.0.0 || <0.5", True),
+    ("semver", "1.2.3", "^1.2.0", True),
+    ("semver", "2.0.0", "^1.2.0", False),
+    ("semver", "0.1.5", "^0.1.2", True),
+    ("semver", "0.2.0", "^0.1.2", False),
+    ("semver", "1.2.9", "~1.2.3", True),
+    ("semver", "1.3.0", "~1.2.3", False),
+    ("gem", "3.0.4", "~>3.0.3", True),
+    ("gem", "3.1.0", "~>3.0.3", False),
+    ("gem", "3.2.1", "~>3.0", True),
+    ("deb", "1.0-1", "<1.0-2", True),
+    ("deb", "1.2~rc1", "<1.2", True),
+    ("pep440", "2.28.1", "<2.31.0", True),
+    ("semver", "1.2.3", "=1.2.3", True),
+    ("semver", "1.2.3", "1.2.3", True),  # bare version = equality
+    ("semver", "1.2.3", "!=1.2.3", False),
+]
+
+
+@pytest.mark.parametrize("scheme,version,expr,want", CONSTRAINT_CASES)
+def test_satisfies(scheme, version, expr, want):
+    assert satisfies(scheme, version, expr) is want
+
+
+def test_maven_letter_aliases():
+    # a/b/m alias to alpha/beta/milestone only when digit-followed
+    assert compare("maven", "1-a1", "1") == -1
+    assert compare("maven", "1-a1", "1-alpha-1") == 0
+    assert compare("maven", "1-m2", "1-milestone-2") == 0
+    assert compare("maven", "1-a", "1") == 1  # bare 'a' = unknown qualifier
+
+
+def test_rpm_tilde_release_vs_empty():
+    assert compare("rpm", "1.0-~rc1", "1.0") == -1
+
+
+def test_semver_many_components():
+    assert compare("semver", "1.2.3.4.5", "1.2.3.4.6") == -1
+    assert compare("semver", "1.2", "1.2.0.0") == 0

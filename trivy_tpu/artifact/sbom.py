@@ -1,0 +1,35 @@
+"""SBOM artifact (ref: pkg/fanal/artifact/sbom/sbom.go:40-96): decode the
+document straight into a cached BlobInfo — no walking."""
+
+from __future__ import annotations
+
+from trivy_tpu.cache.key import calc_blob_key
+from trivy_tpu.sbom.decode import decode
+from trivy_tpu.types import ArtifactReference
+
+
+class SBOMArtifact:
+    type = "cyclonedx"
+
+    def __init__(self, path: str, cache):
+        self.path = path
+        self.cache = cache
+
+    def inspect(self) -> ArtifactReference:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        from trivy_tpu.sbom import detect_format
+
+        fmt = detect_format(data)
+        blob = decode(data)
+        blob_dict = blob.to_dict()
+        blob_id = calc_blob_key(blob_dict)
+        _, missing = self.cache.missing_blobs(blob_id, [blob_id])
+        if missing:
+            self.cache.put_blob(blob_id, blob_dict)
+        return ArtifactReference(
+            name=self.path,
+            type="spdx" if fmt.startswith("spdx") else "cyclonedx",
+            id=blob_id,
+            blob_ids=[blob_id],
+        )

@@ -60,6 +60,7 @@ def _scan_options(opts) -> ScanOptions:
     return ScanOptions(
         scanners=opts.get("scanners", ["secret"]),
         license_full=bool(opts.get("license_full")),
+        list_all_pkgs=bool(opts.get("list_all_pkgs")),
     )
 
 

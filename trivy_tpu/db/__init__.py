@@ -1,0 +1,153 @@
+"""Vulnerability database (ref: pkg/db + aquasecurity/trivy-db).
+
+The reference distributes a bbolt DB as an OCI artifact with buckets
+``"<family> <release>"`` (OS advisories) / ``"<eco>::<source>"`` (library
+advisories) plus a ``vulnerability`` detail bucket. This build flattens the
+same logical schema into immutable JSON shards loaded into hash indexes —
+the host-side layout that feeds the batched device version-compare path
+(advisory boundary versions encode once per load, packages join by name
+host-side, comparisons run vectorized on device).
+
+Directory layout::
+
+    <db_dir>/metadata.json        {"Version": 2, "UpdatedAt": ..., "NextUpdate": ...}
+    <db_dir>/advisories.json      {"<bucket>": {"<pkg>": [advisory, ...]}}
+    <db_dir>/vulnerability.json   {"<vuln-id>": {detail}}
+
+Both single files and ``advisories/<n>.json`` shard directories load.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+from trivy_tpu import log
+
+logger = log.logger("db")
+
+SCHEMA_VERSION = 2
+
+
+@dataclass
+class Advisory:
+    """One advisory row (trivy-db schema: OS rows carry FixedVersion,
+    library rows carry VulnerableVersions/PatchedVersions ranges)."""
+
+    vulnerability_id: str
+    fixed_version: str = ""
+    vulnerable_versions: list[str] = field(default_factory=list)
+    patched_versions: list[str] = field(default_factory=list)
+    arches: list[str] = field(default_factory=list)
+    status: str = ""
+    severity: str = ""  # per-distro severity override
+    data_source: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Advisory":
+        return cls(
+            vulnerability_id=d.get("VulnerabilityID", ""),
+            fixed_version=d.get("FixedVersion", ""),
+            vulnerable_versions=list(d.get("VulnerableVersions", []) or []),
+            patched_versions=list(d.get("PatchedVersions", []) or []),
+            arches=list(d.get("Arches", []) or []),
+            status=d.get("Status", ""),
+            severity=d.get("Severity", ""),
+            data_source=dict(d.get("DataSource", {}) or {}),
+        )
+
+
+class VulnDB:
+    """Loaded advisory + detail indexes."""
+
+    def __init__(
+        self,
+        buckets: dict[str, dict[str, list[Advisory]]],
+        details: dict[str, dict],
+        metadata: dict | None = None,
+    ):
+        self.buckets = buckets
+        self.details = details
+        self.metadata = metadata or {}
+        self._prefix_index: dict[str, list[str]] = {}
+
+    # -- advisory lookup ----------------------------------------------------
+
+    def get_advisories(self, bucket: str, pkg_name: str) -> list[Advisory]:
+        """Exact bucket lookup (OS path: '<family> <release>')."""
+        return self.buckets.get(bucket, {}).get(pkg_name, [])
+
+    def buckets_with_prefix(self, prefix: str) -> list[str]:
+        """Library path: every data source under '<eco>::' (ref:
+        pkg/detector/library/driver.go:115-142)."""
+        if prefix not in self._prefix_index:
+            self._prefix_index[prefix] = sorted(
+                b for b in self.buckets if b.startswith(prefix)
+            )
+        return self._prefix_index[prefix]
+
+    def get_detail(self, vuln_id: str) -> dict:
+        return self.details.get(vuln_id, {})
+
+    # -- loading ------------------------------------------------------------
+
+    @classmethod
+    def load(cls, db_dir: str) -> "VulnDB":
+        meta = {}
+        meta_path = os.path.join(db_dir, "metadata.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            if meta.get("Version", SCHEMA_VERSION) != SCHEMA_VERSION:
+                raise ValueError(
+                    f"unsupported DB schema {meta.get('Version')}, want {SCHEMA_VERSION}"
+                )
+        buckets: dict[str, dict[str, list[Advisory]]] = {}
+
+        def load_adv_file(path: str) -> None:
+            with open(path) as f:
+                raw = json.load(f)
+            for bucket, pkgs in raw.items():
+                dst = buckets.setdefault(bucket, {})
+                for pkg, rows in pkgs.items():
+                    dst.setdefault(pkg, []).extend(
+                        Advisory.from_dict(r) for r in rows
+                    )
+
+        single = os.path.join(db_dir, "advisories.json")
+        shard_dir = os.path.join(db_dir, "advisories")
+        if os.path.exists(single):
+            load_adv_file(single)
+        if os.path.isdir(shard_dir):
+            for name in sorted(os.listdir(shard_dir)):
+                if name.endswith(".json"):
+                    load_adv_file(os.path.join(shard_dir, name))
+
+        details: dict[str, dict] = {}
+        vpath = os.path.join(db_dir, "vulnerability.json")
+        if os.path.exists(vpath):
+            with open(vpath) as f:
+                details = json.load(f)
+        logger.debug(
+            "loaded DB: %d buckets, %d vuln details", len(buckets), len(details)
+        )
+        return cls(buckets, details, meta)
+
+
+def load_default_db(db_repository: str | None, cache_dir: str | None) -> VulnDB | None:
+    """DB resolution: explicit --db-repository dir, else <cache>/db."""
+    candidates = []
+    if db_repository:
+        candidates.append(db_repository)
+    from trivy_tpu.cache.fs import default_cache_dir
+
+    candidates.append(os.path.join(cache_dir or default_cache_dir(), "db"))
+    for cand in candidates:
+        if os.path.isdir(cand) and (
+            os.path.exists(os.path.join(cand, "advisories.json"))
+            or os.path.isdir(os.path.join(cand, "advisories"))
+        ):
+            return VulnDB.load(cand)
+    return None

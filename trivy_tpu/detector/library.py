@@ -1,0 +1,243 @@
+"""Language-ecosystem vulnerability detection (ref: pkg/detector/library/driver.go).
+
+Ecosystem → (bucket prefix, version scheme) map for advisory lookup by
+``"<eco>::"`` bucket prefix; a package is vulnerable when its version
+falls in VulnerableVersions (or below a PatchedVersion when only patches
+are listed). The fixed version surfaced to the user is the smallest patched
+version above the installed one.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu import log
+from trivy_tpu.db import Advisory
+from trivy_tpu.types import Application, DetectedVulnerability
+from trivy_tpu.version import compare, parse_constraints, satisfies
+from trivy_tpu.version.compare import Constraint
+
+logger = log.logger("detector:library")
+
+# app type -> (ecosystem bucket prefix, version scheme)
+# (ref: driver.go:26-98 NewDriver ecosystem switch)
+ECOSYSTEMS: dict[str, tuple[str, str]] = {
+    "npm": ("npm", "npm"),
+    "node-pkg": ("npm", "npm"),
+    "yarn": ("npm", "npm"),
+    "pnpm": ("npm", "npm"),
+    "bun": ("npm", "npm"),
+    "jar": ("maven", "maven"),
+    "pom": ("maven", "maven"),
+    "gradle-lockfile": ("maven", "maven"),
+    "sbt-lockfile": ("maven", "maven"),
+    "pip": ("pip", "pep440"),
+    "pipenv": ("pip", "pep440"),
+    "poetry": ("pip", "pep440"),
+    "uv": ("pip", "pep440"),
+    "python-pkg": ("pip", "pep440"),
+    "gemspec": ("rubygems", "gem"),
+    "bundler": ("rubygems", "gem"),
+    "cargo": ("cargo", "semver"),
+    "rust-binary": ("cargo", "semver"),
+    "composer": ("composer", "semver"),
+    "composer-vendor": ("composer", "semver"),
+    "gomod": ("go", "semver"),
+    "gobinary": ("go", "semver"),
+    "conan-lock": ("conan", "semver"),
+    "mix-lock": ("erlang", "semver"),
+    "pubspec-lock": ("pub", "semver"),
+    "swift": ("swift", "semver"),
+    "cocoapods": ("cocoapods", "semver"),
+    "nuget": ("nuget", "semver"),
+    "dotnet-core": ("nuget", "semver"),
+    "packages-props": ("nuget", "semver"),
+    "bitnami": ("bitnami", "semver"),
+    "k8s": ("k8s", "semver"),
+}
+
+
+# package count above which the constraint evaluation batches onto device
+BATCH_THRESHOLD = 512
+
+
+def detect(db, app: Application) -> list[DetectedVulnerability]:
+    eco = ECOSYSTEMS.get(app.type)
+    if eco is None:
+        logger.debug("unsupported application type: %s", app.type)
+        return []
+    prefix, scheme = eco
+    buckets = db.buckets_with_prefix(f"{prefix}::")
+
+    # host-side hash join: (pkg, advisory) candidate pairs
+    candidates: list[tuple] = []
+    for pkg in app.packages:
+        if not pkg.version:
+            continue
+        name = _normalize_name(prefix, pkg.name)
+        for bucket in buckets:
+            for adv in db.get_advisories(bucket, name):
+                candidates.append((pkg, adv))
+
+    verdicts = None
+    if len(app.packages) >= BATCH_THRESHOLD:
+        verdicts = _batch_verdicts(scheme, candidates)
+
+    vulns: list[DetectedVulnerability] = []
+    for i, (pkg, adv) in enumerate(candidates):
+        vulnerable = (
+            verdicts[i]
+            if verdicts is not None
+            else _is_vulnerable(scheme, pkg.version, adv)
+        )
+        if vulnerable:
+            vulns.append(
+                DetectedVulnerability(
+                    vulnerability_id=adv.vulnerability_id,
+                    pkg_id=pkg.id,
+                    pkg_name=pkg.name,
+                    pkg_path=pkg.file_path,
+                    pkg_identifier=pkg.identifier,
+                    installed_version=pkg.version,
+                    fixed_version=_fixed_version(scheme, pkg.version, adv),
+                    status="fixed" if (adv.patched_versions or adv.fixed_version) else "affected",
+                    severity=adv.severity or "UNKNOWN",
+                    data_source=adv.data_source,
+                    layer=pkg.layer,
+                )
+            )
+    vulns.sort(key=lambda v: (v.pkg_name, v.vulnerability_id, v.pkg_path))
+    return vulns
+
+
+def _batch_verdicts(scheme: str, candidates: list[tuple]) -> list[bool] | None:
+    """Evaluate every (pkg, advisory) pair's constraints in one device call.
+
+    Builds flat (installed, boundary, op) rows with group indices, runs
+    trivy_tpu.ops.verscmp.check_ops once, then reduces AND within groups
+    and OR across groups host-side. Returns None (host fallback) when any
+    version fails to encode for the scheme.
+    """
+    import numpy as np
+
+    from trivy_tpu.version.encode import ENCODABLE, encode_batch, pad_value
+
+    if scheme not in ENCODABLE or not candidates:
+        return None
+
+    from trivy_tpu.ops.verscmp import OPS, check_ops
+
+    rows_a: list[str] = []  # installed version per constraint row
+    rows_b: list[str] = []  # boundary version
+    rows_op: list[int] = []
+    row_group: list[int] = []  # AND-group id per row
+    group_pair: list[int] = []  # candidate index per AND-group
+    group_empty_true: list[bool] = []
+
+    n_groups = 0
+    pair_has_group: list[list[int]] = []
+    for idx, (pkg, adv) in enumerate(candidates):
+        groups_for_pair: list[int] = []
+        exprs = adv.vulnerable_versions
+        if exprs:
+            parsed = [g for e in exprs for g in parse_constraints(e)]
+        else:
+            # patched/fixed-only advisories: vulnerable iff below every bound
+            bounds = list(adv.patched_versions)
+            if adv.fixed_version:
+                bounds.extend(x.strip() for x in adv.fixed_version.split(","))
+            parsed = (
+                [[Constraint("<", _bound_version(b)) for b in bounds]] if bounds else []
+            )
+        for group in parsed:
+            gid = n_groups
+            n_groups += 1
+            groups_for_pair.append(gid)
+            group_pair.append(idx)
+            group_empty_true.append(len(group) == 0)
+            for c in group:
+                rows_a.append(pkg.version)
+                rows_b.append(c.version)
+                rows_op.append(OPS[c.op])
+                row_group.append(gid)
+        pair_has_group.append(groups_for_pair)
+
+    if not rows_a:
+        return [False] * len(candidates)
+    enc_a = encode_batch(scheme, rows_a)
+    enc_b = encode_batch(scheme, rows_b)
+    if enc_a is None or enc_b is None:
+        return None
+    L = max(enc_a.shape[1], enc_b.shape[1])
+    pv = pad_value(scheme)
+
+    def widen(x):
+        if x.shape[1] == L:
+            return x
+        out = np.full((x.shape[0], L), pv, dtype=np.int32)
+        out[:, : x.shape[1]] = x
+        return out
+
+    ok = np.asarray(check_ops(widen(enc_a), widen(enc_b), np.asarray(rows_op)))
+    group_ok = np.ones(n_groups, dtype=bool)
+    np.logical_and.at(group_ok, np.asarray(row_group), ok)
+    for gid, empty in enumerate(group_empty_true):
+        if empty:
+            group_ok[gid] = True
+    verdicts = [False] * len(candidates)
+    for gid, idx in enumerate(group_pair):
+        if group_ok[gid]:
+            verdicts[idx] = True
+    return verdicts
+
+
+def _normalize_name(ecosystem: str, name: str) -> str:
+    """Per-ecosystem package-name normalization (ref: each comparer's
+    normalization: pip lowercases and folds -_. runs, maven uses g:a)."""
+    if ecosystem == "pip":
+        import re
+
+        return re.sub(r"[-_.]+", "-", name).lower()
+    if ecosystem == "rubygems":
+        return name
+    return name
+
+
+def _is_vulnerable(scheme: str, installed: str, adv: Advisory) -> bool:
+    if adv.vulnerable_versions:
+        # trivy-db stores one constraint AND-group per entry; entries OR
+        return satisfies(scheme, installed, " || ".join(adv.vulnerable_versions))
+    # only patched/fixed listed: vulnerable when below every patched version
+    bounds = list(adv.patched_versions)
+    if adv.fixed_version:
+        bounds.extend(x.strip() for x in adv.fixed_version.split(","))
+    if not bounds:
+        return False
+    return all(
+        not satisfies(scheme, installed, b)
+        and compare(scheme, installed, _bound_version(b)) < 0
+        for b in bounds
+    )
+
+
+def _bound_version(expr: str) -> str:
+    groups = parse_constraints(expr)
+    for g in groups:
+        for c in g:
+            return c.version
+    return expr
+
+
+def _fixed_version(scheme: str, installed: str, adv: Advisory) -> str:
+    candidates = []
+    for b in adv.patched_versions or []:
+        candidates.append(_bound_version(b))
+    if adv.fixed_version:
+        candidates.extend(x.strip() for x in adv.fixed_version.split(","))
+    ups = [c for c in candidates if compare(scheme, c, installed) > 0]
+    if ups:
+        return sorted(ups, key=lambda v: _sort_key(scheme, v, ups))[0]
+    return ", ".join(candidates)
+
+
+def _sort_key(scheme, v, all_versions):
+    # total order via pairwise compares (small candidate lists)
+    return sum(1 for o in all_versions if compare(scheme, o, v) < 0)

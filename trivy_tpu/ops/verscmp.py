@@ -1,0 +1,61 @@
+"""Batched version comparison / constraint evaluation on device.
+
+The CVE-match hot loop (ref: pkg/detector hot loop 2, SURVEY.md §3.1):
+packages join advisories host-side (hash join by name), then every
+(installed, boundary) version pair is compared in one vectorized device
+call over encoded int32 vectors (see trivy_tpu/version/encode.py). Shards
+over the mesh 'data' axis like every other batch kernel.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# op codes for constraint checks
+OPS = {"<": 0, "<=": 1, ">": 2, ">=": 3, "=": 4, "!=": 5}
+
+
+@jax.jit
+def lexcmp(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[N, L] vs [N, L] int32 -> sign [N] in {-1, 0, 1}."""
+    diff = jnp.sign(a - b)  # [-1, 0, 1] per position
+    ne = diff != 0
+    first = jnp.argmax(ne, axis=1)  # first differing position (0 if none)
+    picked = jnp.take_along_axis(diff, first[:, None], axis=1)[:, 0]
+    return jnp.where(ne.any(axis=1), picked, 0)
+
+
+@jax.jit
+def check_ops(a: jax.Array, b: jax.Array, ops: jax.Array) -> jax.Array:
+    """Evaluate ``a <op> b`` per row -> bool [N]."""
+    s = lexcmp(a, b)
+    return jnp.stack(
+        [s < 0, s <= 0, s > 0, s >= 0, s == 0, s != 0], axis=1
+    )[jnp.arange(s.shape[0]), ops]
+
+
+def batch_compare(scheme: str, pairs: list[tuple[str, str]]) -> np.ndarray | None:
+    """Compare many (a, b) version pairs on device; None if un-encodable."""
+    from trivy_tpu.version.encode import encode_batch
+
+    if not pairs:
+        return np.zeros(0, dtype=np.int32)
+    a = encode_batch(scheme, [p[0] for p in pairs])
+    b = encode_batch(scheme, [p[1] for p in pairs])
+    if a is None or b is None:
+        return None
+    L = max(a.shape[1], b.shape[1])
+    from trivy_tpu.version.encode import pad_value
+
+    pv = pad_value(scheme)
+
+    def widen(x):
+        if x.shape[1] == L:
+            return x
+        out = np.full((x.shape[0], L), pv, dtype=np.int32)
+        out[:, : x.shape[1]] = x
+        return out
+
+    return np.asarray(lexcmp(widen(a), widen(b)))

@@ -19,6 +19,7 @@ class ScanOptions:
     include_dev_deps: bool = False
     pkg_types: list[str] = field(default_factory=lambda: ["os", "library"])
     detection_priority: str = "precise"
+    list_all_pkgs: bool = False
 
 
 class Scanner:
