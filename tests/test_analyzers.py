@@ -1,0 +1,301 @@
+"""OS / package / lockfile analyzer tests with realistic fixtures."""
+
+import pytest
+
+from trivy_tpu.dependency import parsers as P
+from trivy_tpu.fanal.analyzer import AnalysisInput
+from trivy_tpu.fanal.walker import FileInfo
+
+
+def run_analyzer(cls, path: str, content: bytes):
+    a = cls(None)
+    info = FileInfo(size=len(content), mode=0o644)
+    assert a.required(path, info), f"{cls.__name__} did not require {path}"
+    return a.analyze(
+        AnalysisInput(dir="/x", file_path=path, info=info, content=content)
+    )
+
+
+def test_os_release_ubuntu():
+    from trivy_tpu.fanal.analyzers.os_release import OSReleaseAnalyzer
+
+    content = b'NAME="Ubuntu"\nID=ubuntu\nVERSION_ID="22.04"\n'
+    r = run_analyzer(OSReleaseAnalyzer, "etc/os-release", content)
+    assert r.os.family == "ubuntu" and r.os.name == "22.04"
+
+
+def test_os_release_wolfi_and_id_like():
+    from trivy_tpu.fanal.analyzers.os_release import OSReleaseAnalyzer
+
+    r = run_analyzer(
+        OSReleaseAnalyzer, "etc/os-release", b"ID=wolfi\nVERSION_ID=20230201\n"
+    )
+    assert r.os.family == "wolfi"
+    r = run_analyzer(
+        OSReleaseAnalyzer,
+        "etc/os-release",
+        b"ID=linuxmint\nID_LIKE=ubuntu\nVERSION_ID=21\n",
+    )
+    assert r.os.family == "ubuntu"
+
+
+def test_alpine_release():
+    from trivy_tpu.fanal.analyzers.os_release import AlpineReleaseAnalyzer
+
+    r = run_analyzer(AlpineReleaseAnalyzer, "etc/alpine-release", b"3.18.4\n")
+    assert r.os.family == "alpine" and r.os.name == "3.18"
+
+
+def test_redhat_release():
+    from trivy_tpu.fanal.analyzers.os_release import RedHatReleaseAnalyzer
+
+    r = run_analyzer(
+        RedHatReleaseAnalyzer,
+        "etc/redhat-release",
+        b"CentOS Linux release 8.4.2105 (Core)\n",
+    )
+    assert r.os.family == "centos" and r.os.name == "8.4.2105"
+
+
+APK_DB = b"""C:Q1abc=
+P:musl
+V:1.2.4-r2
+A:x86_64
+L:MIT
+o:musl
+F:lib
+R:ld-musl-x86_64.so.1
+
+P:busybox
+V:1.36.1-r5
+A:x86_64
+L:GPL-2.0-only
+o:busybox
+"""
+
+
+def test_apk_analyzer():
+    from trivy_tpu.fanal.analyzers.pkg_apk import ApkAnalyzer
+
+    r = run_analyzer(ApkAnalyzer, "lib/apk/db/installed", APK_DB)
+    pkgs = r.package_infos[0].packages
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("musl", "1.2.4-r2"),
+        ("busybox", "1.36.1-r5"),
+    ]
+    assert pkgs[0].licenses == ["MIT"]
+    assert "lib/ld-musl-x86_64.so.1" in r.system_files
+
+
+DPKG_STATUS = b"""Package: openssl
+Status: install ok installed
+Architecture: amd64
+Version: 3.0.11-1~deb12u2
+Description: Secure Sockets Layer toolkit
+
+Package: libssl3
+Status: install ok installed
+Source: openssl (3.0.11-1~deb12u2)
+Architecture: amd64
+Version: 3.0.11-1~deb12u2
+
+Package: removed-pkg
+Status: deinstall ok config-files
+Version: 1.0-1
+"""
+
+
+def test_dpkg_analyzer():
+    from trivy_tpu.fanal.analyzers.pkg_dpkg import DpkgAnalyzer
+
+    r = run_analyzer(DpkgAnalyzer, "var/lib/dpkg/status", DPKG_STATUS)
+    pkgs = {p.name: p for p in r.package_infos[0].packages}
+    assert set(pkgs) == {"openssl", "libssl3"}
+    assert pkgs["libssl3"].src_name == "openssl"
+    assert pkgs["openssl"].version == "3.0.11"
+    assert pkgs["openssl"].release == "1~deb12u2"
+
+
+def test_dpkg_list_file():
+    from trivy_tpu.fanal.analyzers.pkg_dpkg import DpkgAnalyzer
+
+    r = run_analyzer(
+        DpkgAnalyzer,
+        "var/lib/dpkg/info/libssl3.list",
+        b"/.\n/usr/lib/x86_64-linux-gnu/libssl.so.3\n",
+    )
+    assert r.system_files == ["usr/lib/x86_64-linux-gnu/libssl.so.3"]
+
+
+# --- parsers ---------------------------------------------------------------
+
+
+def test_parse_gomod():
+    content = b"""module example.com/app
+
+go 1.21
+
+require (
+\tgithub.com/gin-gonic/gin v1.9.1
+\tgolang.org/x/crypto v0.14.0 // indirect
+)
+
+require github.com/stretchr/testify v1.8.4
+"""
+    pkgs = {p.name: p for p in P.parse_gomod(content)}
+    assert pkgs["github.com/gin-gonic/gin"].version == "1.9.1"
+    assert pkgs["golang.org/x/crypto"].indirect
+    assert pkgs["github.com/stretchr/testify"].version == "1.8.4"
+
+
+def test_parse_npm_lock_v3():
+    content = b"""{
+  "name": "app", "lockfileVersion": 3,
+  "packages": {
+    "": {"name": "app", "version": "1.0.0"},
+    "node_modules/lodash": {"version": "4.17.21"},
+    "node_modules/a/node_modules/b": {"version": "2.0.0", "dev": true}
+  }
+}"""
+    pkgs = {p.name: p for p in P.parse_npm_lock(content)}
+    assert pkgs["lodash"].version == "4.17.21"
+    assert pkgs["b"].dev
+
+
+def test_parse_npm_lock_v1():
+    content = b"""{
+  "dependencies": {
+    "lodash": {"version": "4.17.20",
+      "dependencies": {"nested": {"version": "1.0.0"}}}
+  }
+}"""
+    pkgs = {p.name: p for p in P.parse_npm_lock(content)}
+    assert pkgs["lodash"].version == "4.17.20"
+    assert pkgs["nested"].indirect
+
+
+def test_parse_yarn_lock():
+    content = b'''# yarn lockfile v1
+
+lodash@^4.17.0, lodash@^4.17.15:
+  version "4.17.21"
+  resolved "https://registry.yarnpkg.com/lodash/..."
+
+"@babel/core@^7.0.0":
+  version "7.23.0"
+'''
+    pkgs = {p.name: p for p in P.parse_yarn_lock(content)}
+    assert pkgs["lodash"].version == "4.17.21"
+    assert pkgs["@babel/core"].version == "7.23.0"
+
+
+def test_parse_pnpm_lock():
+    content = b"""lockfileVersion: '6.0'
+packages:
+  /lodash@4.17.21:
+    resolution: {integrity: sha512-x}
+  /@babel/core@7.23.0:
+    resolution: {integrity: sha512-y}
+"""
+    pkgs = {p.name: p for p in P.parse_pnpm_lock(content)}
+    assert pkgs["lodash"].version == "4.17.21"
+    assert pkgs["@babel/core"].version == "7.23.0"
+
+
+def test_parse_python_family():
+    assert P.parse_requirements(b"django==4.1.5\n# c\nflask>=2\n")[0].name == "django"
+    pip = P.parse_pipfile_lock(
+        b'{"default": {"django": {"version": "==4.1.5"}}, "develop": {"pytest": {"version": "==7.0.0"}}}'
+    )
+    assert {(p.name, p.dev) for p in pip} == {("django", False), ("pytest", True)}
+    poetry = P.parse_poetry_lock(
+        b'[[package]]\nname = "django"\nversion = "4.1.5"\ncategory = "main"\n'
+    )
+    assert poetry[0].name == "django"
+
+
+def test_parse_gemfile_cargo_composer():
+    gem = P.parse_gemfile_lock(
+        b"GEM\n  remote: https://rubygems.org/\n  specs:\n    rails (7.0.4)\n      actionpack (= 7.0.4)\n\nDEPENDENCIES\n  rails\n"
+    )
+    assert ("rails", "7.0.4") in {(p.name, p.version) for p in gem}
+    cargo = P.parse_cargo_lock(
+        b'[[package]]\nname = "serde"\nversion = "1.0.188"\n'
+    )
+    assert cargo[0].name == "serde"
+    composer = P.parse_composer_lock(
+        b'{"packages": [{"name": "monolog/monolog", "version": "v3.4.0", "license": ["MIT"]}]}'
+    )
+    assert composer[0].version == "3.4.0" and composer[0].licenses == ["MIT"]
+
+
+def test_parse_pom_and_jar():
+    pom = b"""<?xml version="1.0"?>
+<project xmlns="http://maven.apache.org/POM/4.0.0">
+  <groupId>com.example</groupId><artifactId>app</artifactId>
+  <version>1.0.0</version>
+  <properties><jackson.version>2.15.2</jackson.version></properties>
+  <dependencies>
+    <dependency>
+      <groupId>com.fasterxml.jackson.core</groupId>
+      <artifactId>jackson-databind</artifactId>
+      <version>${jackson.version}</version>
+    </dependency>
+    <dependency>
+      <groupId>junit</groupId><artifactId>junit</artifactId>
+      <version>4.13.2</version><scope>test</scope>
+    </dependency>
+  </dependencies>
+</project>"""
+    pkgs = {p.name: p for p in P.parse_pom(pom)}
+    assert pkgs["com.fasterxml.jackson.core:jackson-databind"].version == "2.15.2"
+    assert pkgs["junit:junit"].dev
+    jars = P.parse_jar_name("libs/jackson-databind-2.15.2.jar")
+    assert jars[0].name == "jackson-databind" and jars[0].version == "2.15.2"
+
+
+def test_parse_misc_ecosystems():
+    assert P.parse_gradle_lock(
+        b"org.slf4j:slf4j-api:2.0.9=runtimeClasspath\n"
+    )[0].name == "org.slf4j:slf4j-api"
+    nuget = P.parse_nuget_lock(
+        b'{"dependencies": {"net8.0": {"Newtonsoft.Json": {"type": "Direct", "resolved": "13.0.3"}}}}'
+    )
+    assert nuget[0].version == "13.0.3"
+    mix = P.parse_mix_lock(
+        b'%{\n  "phoenix": {:hex, :phoenix, "1.7.9", "abc", [:mix], [], "hexpm"},\n}\n'
+    )
+    assert mix[0].version == "1.7.9"
+    pub = P.parse_pubspec_lock(
+        b'packages:\n  http:\n    dependency: "direct main"\n    version: "1.1.0"\n'
+    )
+    assert pub[0].version == "1.1.0"
+    pods = P.parse_podfile_lock(b"PODS:\n  - Alamofire (5.8.0)\n  - Alamofire/Core (5.8.0)\n")
+    assert [(p.name, p.version) for p in pods] == [("Alamofire", "5.8.0")]
+    swift = P.parse_swift_resolved(
+        b'{"pins": [{"identity": "alamofire", "location": "https://github.com/Alamofire/Alamofire.git", "state": {"version": "5.8.0"}}]}'
+    )
+    assert swift[0].name.endswith("Alamofire")
+
+
+def test_fs_scan_detects_os_and_lockfiles(tmp_path):
+    """Integration: rootfs-style tree -> OS + packages + apps in one scan."""
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.types import BlobInfo
+
+    (tmp_path / "etc").mkdir()
+    (tmp_path / "etc" / "alpine-release").write_text("3.18.4\n")
+    (tmp_path / "lib" / "apk" / "db").mkdir(parents=True)
+    (tmp_path / "lib" / "apk" / "db" / "installed").write_bytes(APK_DB)
+    (tmp_path / "app").mkdir()
+    (tmp_path / "app" / "package-lock.json").write_text(
+        '{"lockfileVersion": 3, "packages": {"node_modules/lodash": {"version": "4.17.20"}}}'
+    )
+    cache = new_cache("memory")
+    ref = LocalFSArtifact(str(tmp_path), cache, ArtifactOption(backend="cpu")).inspect()
+    blob = BlobInfo.from_dict(cache.get_blob(ref.blob_ids[0]))
+    assert blob.os.family == "alpine" and blob.os.name == "3.18"
+    assert {p.name for p in blob.package_infos[0].packages} == {"musl", "busybox"}
+    apps = {a.type: a for a in blob.applications}
+    assert apps["npm"].packages[0].name == "lodash"

@@ -1,0 +1,5 @@
+"""Dependency parsers (ref: pkg/dependency/parser — 30 parsers).
+
+Each parser: ``parse(content: bytes, file_path: str) -> list[Package]``,
+with relationships/dev flags filled where the format carries them.
+"""

@@ -1,0 +1,414 @@
+"""Lockfile/manifest parsers for the priority ecosystems
+(ref: pkg/dependency/parser/*; formats parsed from their public specs).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from trivy_tpu.types import Package
+
+
+def _pkg(name: str, version: str, **kw) -> Package:
+    p = Package(name=name, version=version, **kw)
+    p.id = f"{name}@{version}"
+    return p
+
+
+# --- go.mod (ref: parser/golang/mod) ---------------------------------------
+
+_GOMOD_REQ = re.compile(r"^\s*(?P<mod>\S+)\s+(?P<ver>v\S+?)(?:\s*//\s*(?P<c>.*))?$")
+
+
+def parse_gomod(content: bytes, path: str = "") -> list[Package]:
+    pkgs: list[Package] = []
+    in_require = False
+    for raw in content.decode("utf-8", "replace").splitlines():
+        line = raw.split("//", 1)[0].rstrip() if "// indirect" not in raw else raw.rstrip()
+        s = line.strip()
+        if s.startswith("require ("):
+            in_require = True
+            continue
+        if in_require and s == ")":
+            in_require = False
+            continue
+        m = None
+        if in_require:
+            m = _GOMOD_REQ.match(raw)
+        elif s.startswith("require "):
+            m = _GOMOD_REQ.match(raw.replace("require ", "", 1))
+        if m and m.group("mod") != "(":
+            indirect = "indirect" in (m.group("c") or "")
+            pkgs.append(
+                _pkg(
+                    m.group("mod"),
+                    m.group("ver").lstrip("v"),
+                    indirect=indirect,
+                    relationship="indirect" if indirect else "direct",
+                )
+            )
+    return pkgs
+
+
+# --- npm package-lock.json (v1/v2/v3, ref: parser/nodejs/npm) ---------------
+
+
+def parse_npm_lock(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    out: dict[tuple[str, str], Package] = {}
+    if "packages" in doc:  # lockfile v2/v3
+        for loc, meta in doc["packages"].items():
+            if not loc:  # "" is the root project
+                continue
+            name = meta.get("name") or loc.split("node_modules/")[-1]
+            version = meta.get("version", "")
+            if not version:
+                continue
+            key = (name, version)
+            if key not in out:
+                out[key] = _pkg(
+                    name,
+                    version,
+                    dev=bool(meta.get("dev")),
+                    indirect="node_modules/" in loc.replace(f"node_modules/{name}", "", 1),
+                )
+    else:  # lockfile v1: nested dependencies
+        def walk(deps: dict, depth: int):
+            for name, meta in (deps or {}).items():
+                version = meta.get("version", "")
+                if version:
+                    key = (name, version)
+                    if key not in out:
+                        out[key] = _pkg(
+                            name, version, dev=bool(meta.get("dev")), indirect=depth > 0
+                        )
+                walk(meta.get("dependencies", {}), depth + 1)
+
+        walk(doc.get("dependencies", {}), 0)
+    return [out[k] for k in sorted(out)]
+
+
+# --- yarn.lock (classic v1 format, ref: parser/nodejs/yarn) -----------------
+
+_YARN_HEADER = re.compile(r'^"?(?P<name>(?:@[^@/"]+/)?[^@/"]+)@')
+_YARN_VERSION = re.compile(r'^\s{2}version:?\s+"?(?P<v>[^"\s]+)"?')
+
+
+def parse_yarn_lock(content: bytes, path: str = "") -> list[Package]:
+    out: dict[tuple[str, str], Package] = {}
+    name = None
+    for line in content.decode("utf-8", "replace").splitlines():
+        if not line.strip() or line.lstrip().startswith("#"):
+            continue
+        if not line.startswith(" "):
+            m = _YARN_HEADER.match(line.strip().rstrip(":"))
+            name = m.group("name") if m else None
+            continue
+        m = _YARN_VERSION.match(line)
+        if m and name:
+            key = (name, m.group("v"))
+            out.setdefault(key, _pkg(name, m.group("v")))
+    return [out[k] for k in sorted(out)]
+
+
+# --- pnpm-lock.yaml (v6/v9 key styles, ref: parser/nodejs/pnpm) -------------
+
+
+def parse_pnpm_lock(content: bytes, path: str = "") -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    out: dict[tuple[str, str], Package] = {}
+    for key in (doc.get("packages") or {}):
+        key = key.strip()
+        name = version = ""
+        if key.startswith("/"):  # v5/v6: /name@version or /name/version
+            body = key[1:]
+            if "@" in body[1:]:
+                name, _, version = body.rpartition("@")
+            else:
+                name, _, version = body.rpartition("/")
+        else:  # v9: name@version
+            name, _, version = key.rpartition("@")
+        version = version.split("(", 1)[0]
+        if name and version:
+            out.setdefault((name, version), _pkg(name, version))
+    return [out[k] for k in sorted(out)]
+
+
+# --- pip requirements.txt (ref: parser/python/pip) --------------------------
+
+_REQ_LINE = re.compile(r"^(?P<name>[A-Za-z0-9][A-Za-z0-9._-]*)\s*==\s*(?P<ver>[^\s;#]+)")
+
+
+def parse_requirements(content: bytes, path: str = "") -> list[Package]:
+    pkgs = []
+    for line in content.decode("utf-8", "replace").splitlines():
+        line = line.strip()
+        if not line or line.startswith(("#", "-")):
+            continue
+        m = _REQ_LINE.match(line)
+        if m:
+            pkgs.append(_pkg(m.group("name"), m.group("ver")))
+    return pkgs
+
+
+# --- Pipfile.lock (ref: parser/python/pipenv) -------------------------------
+
+
+def parse_pipfile_lock(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    pkgs = []
+    for section, dev in (("default", False), ("develop", True)):
+        for name, meta in (doc.get(section) or {}).items():
+            ver = (meta or {}).get("version", "")
+            if ver.startswith("=="):
+                pkgs.append(_pkg(name, ver[2:], dev=dev))
+    return pkgs
+
+
+# --- poetry.lock / uv.lock / Cargo.lock (TOML [[package]]) ------------------
+
+
+def _parse_toml_packages(content: bytes, dev_groups: bool = False) -> list[Package]:
+    import tomllib
+
+    doc = tomllib.loads(content.decode("utf-8", "replace"))
+    pkgs = []
+    for entry in doc.get("package", []) or []:
+        name, version = entry.get("name"), entry.get("version")
+        if name and version:
+            dev = entry.get("category") == "dev" if dev_groups else False
+            pkgs.append(_pkg(name, version, dev=dev))
+    return pkgs
+
+
+def parse_poetry_lock(content: bytes, path: str = "") -> list[Package]:
+    return _parse_toml_packages(content, dev_groups=True)
+
+
+def parse_uv_lock(content: bytes, path: str = "") -> list[Package]:
+    return _parse_toml_packages(content)
+
+
+def parse_cargo_lock(content: bytes, path: str = "") -> list[Package]:
+    return _parse_toml_packages(content)
+
+
+# --- Gemfile.lock (ref: parser/ruby/bundler) --------------------------------
+
+_GEM_SPEC = re.compile(r"^    (?P<name>\S+) \((?P<ver>[^)]+)\)$")
+
+
+def parse_gemfile_lock(content: bytes, path: str = "") -> list[Package]:
+    pkgs = []
+    in_gem = False
+    for line in content.decode("utf-8", "replace").splitlines():
+        if line.rstrip() in ("GEM", "GIT", "PATH"):
+            in_gem = True
+            continue
+        if line.strip() == "" or not line.startswith(" "):
+            in_gem = line.rstrip() in ("GEM",)
+            continue
+        if in_gem:
+            m = _GEM_SPEC.match(line)
+            if m:
+                pkgs.append(_pkg(m.group("name"), m.group("ver")))
+    return pkgs
+
+
+# --- composer.lock (ref: parser/php/composer) -------------------------------
+
+
+def parse_composer_lock(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    pkgs = []
+    for section, dev in (("packages", False), ("packages-dev", True)):
+        for meta in doc.get(section, []) or []:
+            name, ver = meta.get("name"), str(meta.get("version", "")).lstrip("v")
+            if name and ver:
+                lic = meta.get("license") or []
+                pkgs.append(
+                    _pkg(name, ver, dev=dev, licenses=lic if isinstance(lic, list) else [lic])
+                )
+    return pkgs
+
+
+# --- gradle.lockfile (ref: parser/java/gradle) ------------------------------
+
+
+def parse_gradle_lock(content: bytes, path: str = "") -> list[Package]:
+    pkgs = []
+    for line in content.decode("utf-8", "replace").splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        coord = line.split("=", 1)[0]
+        parts = coord.split(":")
+        if len(parts) == 3:
+            pkgs.append(_pkg(f"{parts[0]}:{parts[1]}", parts[2]))
+    return pkgs
+
+
+# --- NuGet packages.lock.json (ref: parser/nuget/lock) ----------------------
+
+
+def parse_nuget_lock(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    out: dict[tuple[str, str], Package] = {}
+    for _fw, deps in (doc.get("dependencies") or {}).items():
+        for name, meta in (deps or {}).items():
+            ver = (meta or {}).get("resolved", "")
+            if ver:
+                out.setdefault(
+                    (name, ver),
+                    _pkg(name, ver, indirect=(meta.get("type") == "Transitive")),
+                )
+    return [out[k] for k in sorted(out)]
+
+
+# --- Maven pom.xml (single-file resolution, ref: parser/java/pom) -----------
+
+
+def parse_pom(content: bytes, path: str = "") -> list[Package]:
+    import xml.etree.ElementTree as ET
+
+    try:
+        root = ET.fromstring(content)
+    except ET.ParseError:
+        return []
+    ns = ""
+    if root.tag.startswith("{"):
+        ns = root.tag.split("}")[0] + "}"
+
+    def text(el, tag, default=""):
+        node = el.find(f"{ns}{tag}")
+        return (node.text or "").strip() if node is not None and node.text else default
+
+    props = {}
+    props_el = root.find(f"{ns}properties")
+    if props_el is not None:
+        for child in props_el:
+            tag = child.tag.replace(ns, "")
+            props[tag] = (child.text or "").strip()
+    props.setdefault("project.version", text(root, "version"))
+    props.setdefault("project.groupId", text(root, "groupId"))
+
+    def interp(v: str) -> str:
+        m = re.fullmatch(r"\$\{([^}]+)\}", v or "")
+        return props.get(m.group(1), "") if m else (v or "")
+
+    pkgs = []
+    deps = root.find(f"{ns}dependencies")
+    if deps is not None:
+        for dep in deps.findall(f"{ns}dependency"):
+            g = interp(text(dep, "groupId"))
+            a = interp(text(dep, "artifactId"))
+            v = interp(text(dep, "version"))
+            scope = text(dep, "scope")
+            if g and a and v:
+                pkgs.append(_pkg(f"{g}:{a}", v, dev=scope == "test"))
+    return pkgs
+
+
+# --- jar/war/ear filename heuristic (ref: parser/java/jar without javadb) ---
+
+_JAR_NAME = re.compile(r"^(?P<name>.+?)-(?P<ver>\d[\w.+-]*?)(?:[-.](?:sources|javadoc|tests))?\.[jwe]ar$")
+
+
+def parse_jar_name(file_path: str) -> list[Package]:
+    import os.path
+
+    base = os.path.basename(file_path)
+    m = _JAR_NAME.match(base)
+    if not m:
+        return []
+    return [_pkg(m.group("name"), m.group("ver"), file_path=file_path)]
+
+
+# --- Conan lock (ref: parser/c/conan) ---------------------------------------
+
+
+def parse_conan_lock(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    pkgs = []
+    reqs = doc.get("requires") or []
+    if isinstance(reqs, list):  # v2 lockfile
+        for r in reqs:
+            ref = r.split("#", 1)[0]
+            if "/" in ref:
+                name, _, ver = ref.partition("/")
+                pkgs.append(_pkg(name, ver.split("@", 1)[0]))
+    nodes = (doc.get("graph_lock") or {}).get("nodes") or {}
+    for _nid, node in nodes.items():  # v1 lockfile
+        ref = (node or {}).get("ref", "")
+        ref = ref.split("#", 1)[0]
+        if "/" in ref:
+            name, _, ver = ref.partition("/")
+            pkgs.append(_pkg(name, ver.split("@", 1)[0]))
+    return pkgs
+
+
+# --- mix.lock (ref: parser/hex/mix) -----------------------------------------
+
+_MIX_RE = re.compile(r'"(?P<name>[^"]+)":\s*\{:hex,\s*:(?P<pkg>\w+),\s*"(?P<ver>[^"]+)"')
+
+
+def parse_mix_lock(content: bytes, path: str = "") -> list[Package]:
+    pkgs = []
+    for m in _MIX_RE.finditer(content.decode("utf-8", "replace")):
+        pkgs.append(_pkg(m.group("name"), m.group("ver")))
+    return pkgs
+
+
+# --- pubspec.lock (dart, ref: parser/dart/pub) ------------------------------
+
+
+def parse_pubspec_lock(content: bytes, path: str = "") -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    pkgs = []
+    for name, meta in (doc.get("packages") or {}).items():
+        ver = (meta or {}).get("version", "")
+        if ver:
+            dep_kind = (meta or {}).get("dependency", "")
+            pkgs.append(_pkg(name, ver, indirect="transitive" in dep_kind))
+    return pkgs
+
+
+# --- Podfile.lock (cocoapods, ref: parser/swift/cocoapods) ------------------
+
+
+def parse_podfile_lock(content: bytes, path: str = "") -> list[Package]:
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    pkgs = []
+    for entry in doc.get("PODS") or []:
+        if isinstance(entry, dict):
+            entry = next(iter(entry))
+        m = re.match(r"^(\S+) \(([^)]+)\)$", str(entry))
+        if m:
+            pkgs.append(_pkg(m.group(1).split("/")[0], m.group(2)))
+    # dedup subspecs
+    seen = {}
+    for p in pkgs:
+        seen.setdefault((p.name, p.version), p)
+    return [seen[k] for k in sorted(seen)]
+
+
+# --- Package.resolved (swift, ref: parser/swift/swift) ----------------------
+
+
+def parse_swift_resolved(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    pkgs = []
+    pins = doc.get("pins") or (doc.get("object") or {}).get("pins") or []
+    for pin in pins:
+        name = pin.get("location") or pin.get("repositoryURL") or pin.get("identity", "")
+        ver = (pin.get("state") or {}).get("version", "")
+        if name and ver:
+            pkgs.append(_pkg(name.removesuffix(".git"), ver))
+    return pkgs

@@ -60,6 +60,7 @@ class AnalyzerType(str, enum.Enum):
     GO_BINARY = "gobinary"
     GRADLE_LOCK = "gradle-lockfile"
     JAR = "jar"
+    POM = "pom"
     NPM_PKG_LOCK = "npm"
     NODE_PKG = "node-pkg"
     PNPM = "pnpm"

@@ -1,4 +1,10 @@
 """Built-in analyzers; importing this package registers them all
 (ref: each reference analyzer registers via init(), pkg/fanal/analyzer)."""
 
-from trivy_tpu.fanal.analyzers import secret  # noqa: F401
+from trivy_tpu.fanal.analyzers import (  # noqa: F401
+    lang,
+    os_release,
+    pkg_apk,
+    pkg_dpkg,
+    secret,
+)

@@ -1,0 +1,119 @@
+"""Language lockfile analyzers: one generic analyzer per
+(filename, app type, parser) (ref: pkg/fanal/analyzer/language/* — each
+ecosystem registers a thin analyzer wrapping a dependency parser)."""
+
+from __future__ import annotations
+
+import os.path
+
+from trivy_tpu.dependency import parsers as P
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    Analyzer,
+    AnalyzerType,
+    register_analyzer,
+)
+from trivy_tpu.types import Application
+
+# (analyzer type, app type, filename matcher, parser)
+_SPECS = [
+    (AnalyzerType.GO_MOD, "gomod", lambda n: n == "go.mod", P.parse_gomod),
+    (AnalyzerType.NPM_PKG_LOCK, "npm", lambda n: n == "package-lock.json", P.parse_npm_lock),
+    (AnalyzerType.YARN, "yarn", lambda n: n == "yarn.lock", P.parse_yarn_lock),
+    (AnalyzerType.PNPM, "pnpm", lambda n: n == "pnpm-lock.yaml", P.parse_pnpm_lock),
+    (AnalyzerType.PIP, "pip", lambda n: n == "requirements.txt", P.parse_requirements),
+    (AnalyzerType.PIPENV, "pipenv", lambda n: n == "Pipfile.lock", P.parse_pipfile_lock),
+    (AnalyzerType.POETRY, "poetry", lambda n: n == "poetry.lock", P.parse_poetry_lock),
+    (AnalyzerType.UV, "uv", lambda n: n == "uv.lock", P.parse_uv_lock),
+    (AnalyzerType.CARGO, "cargo", lambda n: n == "Cargo.lock", P.parse_cargo_lock),
+    (AnalyzerType.BUNDLER, "bundler", lambda n: n == "Gemfile.lock", P.parse_gemfile_lock),
+    (AnalyzerType.COMPOSER, "composer", lambda n: n == "composer.lock", P.parse_composer_lock),
+    (AnalyzerType.GRADLE_LOCK, "gradle-lockfile", lambda n: n == "gradle.lockfile", P.parse_gradle_lock),
+    (AnalyzerType.NUGET, "nuget", lambda n: n == "packages.lock.json", P.parse_nuget_lock),
+    (AnalyzerType.CONAN, "conan-lock", lambda n: n in ("conan.lock",), P.parse_conan_lock),
+    (AnalyzerType.MIX_LOCK, "mix-lock", lambda n: n == "mix.lock", P.parse_mix_lock),
+    (AnalyzerType.PUB_SPEC, "pubspec-lock", lambda n: n == "pubspec.lock", P.parse_pubspec_lock),
+    (AnalyzerType.COCOAPODS, "cocoapods", lambda n: n == "Podfile.lock", P.parse_podfile_lock),
+    (AnalyzerType.SWIFT, "swift", lambda n: n == "Package.resolved", P.parse_swift_resolved),
+    (AnalyzerType.JULIA, "julia", lambda n: n == "Manifest.toml", None),  # placeholder
+]
+
+
+def _make(analyzer_type, app_type, matcher, parser):
+    class LockfileAnalyzer(Analyzer):
+        type = analyzer_type
+        version = 1
+
+        def __init__(self, options):
+            pass
+
+        def required(self, file_path: str, info) -> bool:
+            return matcher(os.path.basename(file_path))
+
+        def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+            pkgs = parser(inp.content, inp.file_path)
+            if not pkgs:
+                return None
+            return AnalysisResult(
+                applications=[
+                    Application(type=app_type, file_path=inp.file_path, packages=pkgs)
+                ]
+            )
+
+    LockfileAnalyzer.__name__ = f"{app_type.title().replace('-', '')}Analyzer"
+    return LockfileAnalyzer
+
+
+for _t, _app, _match, _parse in _SPECS:
+    if _parse is not None:
+        register_analyzer(_make(_t, _app, _match, _parse))
+
+
+class JarAnalyzer(Analyzer):
+    """Filename-based JAR identification (the reference enriches via the
+    java DB sha1 lookup, ref: parser/java/jar; offline filename lane here)."""
+
+    type = AnalyzerType.JAR
+    version = 1
+
+    def __init__(self, options):
+        pass
+
+    def required(self, file_path: str, info) -> bool:
+        return file_path.endswith((".jar", ".war", ".ear"))
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        pkgs = P.parse_jar_name(inp.file_path)
+        if not pkgs:
+            return None
+        return AnalysisResult(
+            applications=[
+                Application(type="jar", file_path=inp.file_path, packages=pkgs)
+            ]
+        )
+
+
+class PomAnalyzer(Analyzer):
+    type = AnalyzerType.POM
+    version = 1
+
+    def __init__(self, options):
+        pass
+
+    def required(self, file_path: str, info) -> bool:
+        return os.path.basename(file_path) == "pom.xml"
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        pkgs = P.parse_pom(inp.content, inp.file_path)
+        if not pkgs:
+            return None
+        return AnalysisResult(
+            applications=[
+                Application(type="pom", file_path=inp.file_path, packages=pkgs)
+            ]
+        )
+
+
+register_analyzer(JarAnalyzer)
+register_analyzer(PomAnalyzer)

@@ -1,0 +1,147 @@
+"""License classification over file contents.
+
+The reference wraps google/licenseclassifier v2 (n-gram similarity against
+an SPDX corpus) behind a mutex because it is not thread-safe (ref:
+pkg/licensing/classifier.go:17-54). Here classification is phrase-
+fingerprint matching on normalized text, executed on device for batches:
+the fingerprints compile into the *same* batched literal-match kernel the
+secret engine uses (keyword lane of trivy_tpu/ops/match.py) — one kernel,
+two scanners — with a host fallback for tiny batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from trivy_tpu.licensing.corpus import (
+    MIN_CONFIDENCE,
+    NORMALIZED_FINGERPRINTS,
+    SUBSUMES,
+    normalize,
+)
+from trivy_tpu.types import LicenseFinding
+
+_SPDX_URL = "https://spdx.org/licenses/{}.html"
+
+
+class LicenseClassifier:
+    """classify(text) -> [LicenseFinding]; classify_batch for many files."""
+
+    def __init__(self, backend: str = "auto", confidence: float = MIN_CONFIDENCE):
+        self.confidence = confidence
+        self.backend = backend
+        self._device = None  # (match_fn, compiled-like metadata), built lazily
+        # flat phrase table: (license, phrase, weight)
+        self.licenses = sorted(NORMALIZED_FINGERPRINTS)
+        self.phrases: list[tuple[int, str]] = []
+        for li, lic in enumerate(self.licenses):
+            for ph in NORMALIZED_FINGERPRINTS[lic]:
+                self.phrases.append((li, ph))
+
+    # -- host path ----------------------------------------------------------
+
+    def classify(self, text: str) -> list[LicenseFinding]:
+        norm = normalize(text)
+        hits = np.zeros(len(self.phrases), dtype=bool)
+        for i, (_li, ph) in enumerate(self.phrases):
+            hits[i] = ph in norm
+        return self._findings(hits)
+
+    # -- batched device path ------------------------------------------------
+
+    def classify_batch(self, texts: list[str]) -> list[list[LicenseFinding]]:
+        if len(texts) < 8 or self.backend == "cpu":
+            return [self.classify(t) for t in texts]
+        match_fn, chunk_len, overlap = self._build_device()
+        from trivy_tpu.secret.tpu_scanner import chunk_spans
+
+        rows = []
+        meta = []  # text index per chunk row
+        for ti, text in enumerate(texts):
+            data = normalize(text).encode("latin-1", "replace")
+            for s in chunk_spans(len(data), chunk_len, overlap):
+                row = np.zeros(chunk_len, dtype=np.uint8)
+                piece = data[s : s + chunk_len]
+                row[: len(piece)] = np.frombuffer(piece, dtype=np.uint8)
+                rows.append(row)
+                meta.append(ti)
+        if not rows:
+            return [[] for _ in texts]
+        from trivy_tpu.parallel.mesh import pad_batch
+
+        batch = pad_batch(np.stack(rows), 8)
+        hits = np.asarray(match_fn(batch))[: len(meta)]  # [rows, n_phrases]
+        per_text = np.zeros((len(texts), len(self.phrases)), dtype=bool)
+        for row, ti in enumerate(meta):
+            per_text[ti] |= hits[row]
+        return [self._findings(per_text[ti]) for ti in range(len(texts))]
+
+    def _build_device(self):
+        if self._device is None:
+            from trivy_tpu.ops.match import build_match_fn
+            from trivy_tpu.secret.device_compile import CompiledRules
+
+            compiled = CompiledRules(
+                rule_ids=[f"p{i}" for i in range(len(self.phrases))],
+                classes=np.zeros((1, 256), dtype=bool),
+                variants=[],
+                keywords=[
+                    (i, ph.encode("latin-1", "replace"))
+                    for i, (_li, ph) in enumerate(self.phrases)
+                ],
+                host_rule_ids=[],
+                margin=max(len(ph) for _li, ph in self.phrases) + 1,
+                span=max(len(ph) for _li, ph in self.phrases) + 1,
+            )
+            chunk_len = 8192
+            backend = self.backend
+            if backend == "auto":
+                import jax
+
+                backend = (
+                    "pallas"
+                    if jax.devices()[0].platform not in ("cpu", "METAL")
+                    else "xla"
+                )
+            if backend == "pallas":
+                from trivy_tpu.ops.match_pallas import build_match_fn_pallas
+
+                fn = build_match_fn_pallas(compiled, chunk_len)
+            else:
+                fn = build_match_fn(compiled, chunk_len)
+            self._device = (fn, chunk_len, compiled.span + 1)
+        return self._device
+
+    # -- shared scoring -----------------------------------------------------
+
+    def _findings(self, phrase_hits: np.ndarray) -> list[LicenseFinding]:
+        per_license: dict[int, tuple[int, int]] = {}
+        for i, (li, _ph) in enumerate(self.phrases):
+            got, total = per_license.get(li, (0, 0))
+            per_license[li] = (got + bool(phrase_hits[i]), total + 1)
+        found = []
+        for li, (got, total) in per_license.items():
+            conf = got / total
+            if got and conf >= self.confidence:
+                found.append((conf, total, self.licenses[li]))
+        if not found:
+            return []
+        # specificity: a fully-matched license suppresses licenses it subsumes
+        full = {name for conf, _t, name in found if conf >= 1.0}
+        suppressed = {s for name in full for s in SUBSUMES.get(name, [])}
+        found = [f for f in found if f[2] not in suppressed]
+        # prefer higher confidence, then more specific (more phrases)
+        found.sort(key=lambda x: (-x[0], -x[1], x[2]))
+        best_conf = found[0][0]
+        out = []
+        for conf, _total, name in found:
+            if conf < best_conf and len(out) >= 1:
+                break
+            out.append(
+                LicenseFinding(
+                    name=name,
+                    confidence=round(conf, 3),
+                    link=_SPDX_URL.format(name),
+                )
+            )
+        return out

@@ -1,0 +1,139 @@
+"""License fingerprint corpus.
+
+Distinctive phrases per SPDX license id, written against the public license
+texts (the reference wraps google/licenseclassifier's n-gram corpus,
+ref: pkg/licensing/classifier.go). Phrases are matched on normalized text
+(lowercased, whitespace collapsed) and chosen to be (a) unique enough that
+a match strongly implies the license, (b) short enough to survive line
+rewrapping after normalization. Confidence = fraction of phrases present.
+"""
+
+NORMALIZED_FINGERPRINTS: dict[str, list[str]] = {
+    "MIT": [
+        "permission is hereby granted, free of charge, to any person obtaining a copy",
+        "the software is provided \"as is\", without warranty of any kind",
+        "the above copyright notice and this permission notice shall be included",
+    ],
+    "Apache-2.0": [
+        "apache license",
+        "version 2.0, january 2004",
+        "licensed under the apache license, version 2.0",
+        "unless required by applicable law or agreed to in writing",
+    ],
+    "GPL-2.0-only": [
+        "gnu general public license",
+        "version 2, june 1991",
+        "this program is free software; you can redistribute it and/or modify",
+    ],
+    "GPL-3.0-only": [
+        "gnu general public license",
+        "version 3, 29 june 2007",
+        "this program is free software: you can redistribute it and/or modify",
+    ],
+    "LGPL-2.1-only": [
+        "gnu lesser general public license",
+        "version 2.1, february 1999",
+    ],
+    "LGPL-3.0-only": [
+        "gnu lesser general public license",
+        "version 3, 29 june 2007",
+    ],
+    "AGPL-3.0-only": [
+        "gnu affero general public license",
+        "version 3, 19 november 2007",
+    ],
+    "BSD-2-Clause": [
+        "redistribution and use in source and binary forms",
+        "redistributions of source code must retain the above copyright notice",
+        "redistributions in binary form must reproduce the above copyright",
+    ],
+    "BSD-3-Clause": [
+        "redistribution and use in source and binary forms",
+        "neither the name of",
+        "may be used to endorse or promote products derived from this software",
+    ],
+    "ISC": [
+        "permission to use, copy, modify, and/or distribute this software for any purpose",
+        "the software is provided \"as is\" and the author disclaims all warranties",
+    ],
+    "MPL-2.0": [
+        "mozilla public license version 2.0",
+        "this source code form is subject to the terms of the mozilla public",
+    ],
+    "EPL-2.0": [
+        "eclipse public license - v 2.0",
+        "this program and the accompanying materials are made available under the",
+    ],
+    "EPL-1.0": [
+        "eclipse public license - v 1.0",
+    ],
+    "Unlicense": [
+        "this is free and unencumbered software released into the public domain",
+        "anyone is free to copy, modify, publish, use, compile, sell, or distribute",
+    ],
+    "CC0-1.0": [
+        "cc0 1.0 universal",
+        "creative commons",
+        "no copyright",
+    ],
+    "CC-BY-4.0": [
+        "creative commons attribution 4.0 international",
+    ],
+    "CC-BY-SA-4.0": [
+        "creative commons attribution-sharealike 4.0 international",
+    ],
+    "CC-BY-NC-4.0": [
+        "creative commons attribution-noncommercial 4.0 international",
+    ],
+    "WTFPL": [
+        "do what the fuck you want to public license",
+    ],
+    "Zlib": [
+        "this software is provided 'as-is', without any express or implied warranty",
+        "altered source versions must be plainly marked as such",
+    ],
+    "BSL-1.0": [
+        "boost software license - version 1.0",
+    ],
+    "PostgreSQL": [
+        "postgresql license",
+        "permission to use, copy, modify, and distribute this software and its documentation",
+    ],
+    "Artistic-2.0": [
+        "the artistic license 2.0",
+    ],
+    "OpenSSL": [
+        "openssl license",
+        "this product includes software developed by the openssl project",
+    ],
+    "Python-2.0": [
+        "python software foundation license version 2",
+    ],
+    "Ruby": [
+        "you may make and give away verbatim copies of the source form of the software",
+    ],
+    "MIT-0": [
+        "mit no attribution",
+        "permission is hereby granted, free of charge, to any person obtaining a copy",
+    ],
+    "0BSD": [
+        "permission to use, copy, modify, and/or distribute this software for any purpose with or without fee",
+    ],
+}
+
+# when both fully match, the more specific license suppresses the subsumed
+# one (a BSD-3 text contains every BSD-2 phrase)
+SUBSUMES: dict[str, list[str]] = {
+    "BSD-3-Clause": ["BSD-2-Clause"],
+    "GPL-3.0-only": ["GPL-2.0-only"],  # shared "gnu general public license"
+    "AGPL-3.0-only": [],
+}
+
+MIN_CONFIDENCE = 0.9
+
+
+def normalize(text: str) -> str:
+    """Lowercase and collapse every whitespace run to a single space — the
+    same transform applied to fingerprints and scanned content so matches
+    survive arbitrary line wrapping."""
+    return " ".join(text.lower().split())
