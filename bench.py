@@ -1,16 +1,16 @@
 """Secret-scan throughput benchmark.
 
-Headline metric: device-side steady-state scan throughput of the batched
-rule-match kernel (the north-star hot loop, ref: SURVEY.md §2.3) on one
-chip, chunk batches resident in HBM. End-to-end pipeline throughput
-(host chunking + host→device feed + exact host confirmation) is reported in
-``detail`` — note that under the axon tunnel the host→device link runs at
-~30 MB/s, an artifact of the test harness rather than of TPU hardware (real
-deployments feed HBM over PCIe/DMA at GB/s).
+Headline metric: END-TO-END pipeline throughput (host chunking + host→device
+feed + device match + exact host confirmation) — the north-star number
+(BASELINE.md: 100 GB < 60 s end-to-end). Device-kernel steady-state
+throughput and the measured host→device link ceiling are reported in
+``detail``: under the axon tunnel the link runs at ~30 MB/s, an artifact of
+the test harness rather than of TPU hardware (real deployments feed HBM over
+PCIe/DMA at GB/s), so e2e is judged against min(link, kernel).
 
 Baseline: the reference publishes no numbers (BASELINE.md); the north-star
 target is 100 GB in <60 s on a v5e-8 ≈ 1707 MB/s, i.e. ~213 MB/s per chip.
-``vs_baseline`` is headline throughput relative to the per-chip share
+``vs_baseline`` is e2e throughput relative to the per-chip share
 (>1.0 = on track to beat the target at 8-chip scale).
 """
 
@@ -62,9 +62,31 @@ def bench_device(scanner, rng) -> float:
     return reps * n_bytes / dt / (1024 * 1024)
 
 
+def bench_link(scanner, rng) -> float:
+    """Measured host→device transfer ceiling for one dispatch-sized batch."""
+    import jax
+
+    B, C = scanner.batch_size, scanner.chunk_len
+    batch = rng.integers(32, 127, size=(B, C), dtype=np.uint8)
+    jax.block_until_ready(jax.device_put(batch))  # warm-up
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(jax.device_put(batch))
+    dt = time.perf_counter() - t0
+    return reps * B * C / dt / (1024 * 1024)
+
+
+def warm_buckets(scanner) -> None:
+    """Compile every dispatch bucket shape outside the timed region."""
+    C = scanner.chunk_len
+    for b in scanner._buckets:
+        np.asarray(scanner._match(np.zeros((b, C), dtype=np.uint8)))
+
+
 def bench_e2e(scanner, files) -> tuple[float, int]:
     total_bytes = sum(len(d) for _, d in files)
-    list(scanner.scan_files(files[:2]))  # warm-up
+    warm_buckets(scanner)
     t0 = time.perf_counter()
     n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
     dt = time.perf_counter() - t0
@@ -77,19 +99,22 @@ def main():
     rng = np.random.default_rng(42)
     scanner = TpuSecretScanner()
     device_mbs = bench_device(scanner, rng)
+    link_mbs = bench_link(scanner, rng)
     files = make_corpus(E2E_MB, rng)
     e2e_mbs, n_findings = bench_e2e(scanner, files)
 
     print(
         json.dumps(
             {
-                "metric": "secret_scan_device_throughput",
-                "value": round(device_mbs, 2),
+                "metric": "secret_scan_e2e_throughput",
+                "value": round(e2e_mbs, 2),
                 "unit": "MB/s",
-                "vs_baseline": round(device_mbs / PER_CHIP_TARGET_MBS, 3),
+                "vs_baseline": round(e2e_mbs / PER_CHIP_TARGET_MBS, 3),
                 "detail": {
                     "backend": scanner.backend,
-                    "e2e_mbs_via_tunnel": round(e2e_mbs, 2),
+                    "device_kernel_mbs": round(device_mbs, 2),
+                    "host_device_link_mbs": round(link_mbs, 2),
+                    "e2e_vs_link_ceiling": round(e2e_mbs / min(link_mbs, device_mbs), 3),
                     "e2e_corpus_mb": E2E_MB,
                     "findings": n_findings,
                     "per_chip_target_mbs": round(PER_CHIP_TARGET_MBS, 1),

@@ -19,6 +19,12 @@ ADVISORIES = {
             {"VulnerabilityID": "CVE-2023-1111", "FixedVersion": "3.0.11-1~deb12u1"},
         ],
     },
+    # rolling distro: bucket has no version component
+    "wolfi": {
+        "git": [
+            {"VulnerabilityID": "CVE-2023-9999", "FixedVersion": "2.40.1-r0"},
+        ],
+    },
     "npm::GitHub Security Advisory npm": {
         "lodash": [
             {

@@ -34,6 +34,24 @@ def test_ospkg_alpine(db):
     assert "zlib" not in {v.pkg_name for v in vulns}
 
 
+def test_ospkg_alpine_full_version_normalizes_to_major_minor(db):
+    # os-release VERSION_ID is the full "3.18.4" but advisories are bucketed
+    # by major.minor; the driver must normalize or every lookup misses
+    os_info = OS(family="alpine", name="3.18.4")
+    pkgs = [Package(name="musl", version="1.2.3", release="r0")]
+    vulns = ospkg.detect(db, os_info, pkgs)
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2023-0001"]
+
+
+def test_ospkg_wolfi_rolling_versionless_bucket(db):
+    # rolling distros key advisories on a versionless bucket ("wolfi"),
+    # whatever the reported os version is
+    os_info = OS(family="wolfi", name="20230201")
+    pkgs = [Package(name="git", version="2.39.0", release="r0")]
+    vulns = ospkg.detect(db, os_info, pkgs)
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2023-9999"]
+
+
 def test_ospkg_fixed_version_not_vulnerable(db):
     os_info = OS(family="alpine", name="3.18")
     pkgs = [Package(name="musl", version="1.2.4", release="r1")]

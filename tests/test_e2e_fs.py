@@ -65,6 +65,29 @@ def test_cache_reuse(tree, tmp_path):
     assert [r.target for r in r1.results] == [r.target for r in r2.results]
 
 
+def test_nested_secret_config_excluded(tree, tmp_path):
+    # a secret config below the scan root must be skipped wherever it sits,
+    # not only at the root — its example patterns are not findings
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    conf = tree / "conf"
+    conf.mkdir()
+    cfg = conf / "trivy-secret.yaml"
+    cfg.write_text(f"# example: {GHP}\nrules: []\n")
+    cache = new_cache("fs", str(tmp_path / "cache"))
+    artifact = LocalFSArtifact(
+        str(tree), cache,
+        ArtifactOption(backend="cpu", secret_config_path=str(cfg)),
+    )
+    report = Scanner(artifact, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=["secret"])
+    )
+    assert {r.target for r in report.results} == {"src/gh.txt"}
+
+
 def run_cli(*args):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     return subprocess.run(

@@ -1,0 +1,36 @@
+"""The driver invokes __graft_entry__ in a fresh process with no test
+harness env: dryrun_multichip must provision its own virtual devices."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_dryrun_multichip_bootstraps_virtual_devices():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8); print('DRYRUN_OK')"],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "DRYRUN_OK" in proc.stdout
+
+
+def test_entry_compiles():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__, jax; fn, args = __graft_entry__.entry(); "
+         "out = jax.jit(fn)(*args); jax.block_until_ready(out); print('ENTRY_OK')"],
+        cwd=REPO, env=_clean_env(), capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "ENTRY_OK" in proc.stdout

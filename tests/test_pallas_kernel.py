@@ -43,9 +43,13 @@ def test_pallas_interpret_matches_xla():
     with mock.patch.object(match_pallas.pl, "pallas_call", interp):
         fp = match_pallas.build_match_fn_pallas(compiled, CL)
         rows = []
-        for s in sorted(SAMPLES.values())[:8]:
+        picked = sorted(SAMPLES.values())[:8]
+        # half embedded mid-chunk, half at file offset 0 — the offset-0 rows
+        # exercise the word-boundary check at the row edge (a secret first in
+        # a file must still hit; regression for the shifted-in-zeros bug)
+        for i, s in enumerate(picked):
             row = np.zeros(CL, dtype=np.uint8)
-            enc = f"x {s} y".encode("latin-1")[:CL]
+            enc = (s if i % 2 else f"x {s} y").encode("latin-1")[:CL]
             row[: len(enc)] = np.frombuffer(enc, dtype=np.uint8)
             rows.append(row)
         batch = np.stack(rows)
@@ -53,3 +57,6 @@ def test_pallas_interpret_matches_xla():
     fx = build_match_fn(compiled, CL)
     hx = np.asarray(fx(batch))
     assert np.array_equal(hp, hx)
+    # the offset-0 rows must actually hit something (guards against the
+    # equality above passing with both kernels missing)
+    assert hx[1::2].any(axis=1).all()

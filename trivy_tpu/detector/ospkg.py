@@ -24,17 +24,25 @@ class Driver:
     scheme: str  # deb | rpm | apk
     bucket_family: str = ""  # bucket name override
     use_major_version: bool = False  # bucket keyed by major ("redhat 8")
+    use_major_minor: bool = False  # bucket keyed by major.minor ("alpine 3.18")
+    rolling: bool = False  # rolling distro: versionless bucket ("wolfi")
 
     def bucket(self, os_name: str) -> str:
         fam = self.bucket_family or self.family
+        if self.rolling:
+            return fam
         name = os_name
         if self.use_major_version:
             name = os_name.split(".")[0]
+        elif self.use_major_minor:
+            name = ".".join(os_name.split(".")[:2])
         return f"{fam} {name}".strip()
 
 
 DRIVERS: dict[str, Driver] = {
-    "alpine": Driver("alpine", "apk"),
+    # alpine advisories are bucketed by major.minor (ref: alpine detector
+    # trims to osver.Minor) — os-release VERSION_ID is the full "3.18.4"
+    "alpine": Driver("alpine", "apk", use_major_minor=True),
     "debian": Driver("debian", "deb", use_major_version=True),
     "ubuntu": Driver("ubuntu", "deb"),
     "redhat": Driver("redhat", "rpm", use_major_version=True),
@@ -47,8 +55,9 @@ DRIVERS: dict[str, Driver] = {
     "photon": Driver("photon", "rpm"),
     "azurelinux": Driver("azurelinux", "rpm", bucket_family="Azure Linux"),
     "cbl-mariner": Driver("cbl-mariner", "rpm", bucket_family="CBL-Mariner"),
-    "wolfi": Driver("wolfi", "apk", bucket_family="wolfi"),
-    "chainguard": Driver("chainguard", "apk", bucket_family="chainguard"),
+    # rolling distros: trivy-db buckets carry no version component
+    "wolfi": Driver("wolfi", "apk", bucket_family="wolfi", rolling=True),
+    "chainguard": Driver("chainguard", "apk", bucket_family="chainguard", rolling=True),
     "opensuse-leap": Driver("opensuse-leap", "rpm", bucket_family="openSUSE Leap"),
     "sles": Driver("sles", "rpm", bucket_family="SUSE Linux Enterprise"),
 }

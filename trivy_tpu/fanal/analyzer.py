@@ -234,6 +234,7 @@ class AnalyzerOptions:
     secret_config_path: str | None = None
     backend: str = "auto"  # device backend for batched analyzers
     file_checksum: bool = False
+    root: str | None = None  # scan root, for resolving config paths
     extra: dict = field(default_factory=dict)
 
 

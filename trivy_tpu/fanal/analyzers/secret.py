@@ -57,6 +57,17 @@ class SecretAnalyzer(BatchAnalyzer):
     def __init__(self, options):
         cfg = None
         self.config_path = getattr(options, "secret_config_path", None)
+        # resolve the config file to a scan-root-relative path so the
+        # self-exclusion matches at any nesting depth (the reference compares
+        # the full path, not the basename)
+        self._config_rel_path = None
+        if self.config_path:
+            root = getattr(options, "root", None) or "."
+            rel = os.path.relpath(
+                os.path.abspath(self.config_path), os.path.abspath(root)
+            )
+            if not rel.startswith(".."):
+                self._config_rel_path = os.path.normpath(rel)
         if self.config_path and os.path.exists(self.config_path):
             cfg = ScannerConfig.from_yaml_file(self.config_path)
         backend = getattr(options, "backend", "auto")
@@ -76,7 +87,7 @@ class SecretAnalyzer(BatchAnalyzer):
         name = parts[-1]
         if name in SKIP_FILES:
             return False
-        if self.config_path and os.path.basename(self.config_path) == file_path:
+        if self.config_path and self._config_rel_path == os.path.normpath(file_path):
             return False
         ext = os.path.splitext(name)[1]
         if ext in SKIP_EXTS:

@@ -8,9 +8,12 @@ handful of times.
 
 Layout: chunks are *self-contained* rows (the host chunker's overlap already
 guarantees every match window lies fully inside some chunk), so the grid is
-1-D over row blocks — no halo exchange. Shifted reads at row edges see zeros,
-exactly like the XLA version's padding: permissive for boundary checks
-(FP-only) and failing for class windows (covered by the overlap guarantee).
+1-D over row blocks — no halo exchange. Rows are padded with M real zero
+bytes on both sides before the kernel, and every positional read is a static
+slice of that padded plane — byte-for-byte the XLA kernel's semantics
+(match.py:92-98), including class membership *of the padding bytes* and
+word-boundary checks at row edges. This keeps device-hit parity structural
+rather than case-by-case.
 
 VMEM discipline: a single fused kernel would keep every class mask and
 doubling level alive at once (~55 MB — over the 16 MB scoped limit), so
@@ -94,27 +97,35 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
     C = chunk_len
     if C % 128:
         raise ValueError("chunk_len must be a multiple of 128")
+    # zero padding per side, rounded up to the lane width so the padded plane
+    # stays 128-aligned; shifted reads never leave the padded plane
+    M = -(-(compiled.margin + 4) // 128) * 128
+    Cp = C + 2 * M
     R = compiled.num_rules
     class_intervals = _class_intervals(compiled)
     var_groups = _group_variants(compiled.variants, GROUP_MASK_BUDGET)
 
     def make_kernel(group, with_keywords: bool):
         def kernel(x_ref, out_ref):
-            x = x_ref[:].astype(jnp.int32)  # [TB, C]
+            x = x_ref[:].astype(jnp.int32)  # [TB, Cp] zero-padded rows
 
             def b(pred):
                 return pred.astype(jnp.int32)
 
             def shift(arr, d):
-                if d == 0:
-                    return arr
-                z = jnp.zeros_like(arr[:, : abs(d)])
-                if d > 0:
-                    return jnp.concatenate([arr[:, d:], z], axis=1)
-                return jnp.concatenate([z, arr[:, :d]], axis=1)
+                """Plane values at chunk positions p+d — a static slice of
+                the padded plane, so out-of-chunk reads see the real zero
+                padding (the XLA kernel's shift, match.py:96-98)."""
+                return jax.lax.slice_in_dim(arr, M + d, M + d + C, axis=1)
+
+            def roll(arr, w):
+                """Left-shift the full plane by w, zero-filling (doubling
+                step; mirrors match.py:148's jnp.pad of the padded plane)."""
+                z = jnp.zeros_like(arr[:, :w])
+                return jnp.concatenate([arr[:, w:], z], axis=1)
 
             def literal_hit(lit: bytes, data):
-                ok = b(data == lit[0])
+                ok = b(shift(data, 0) == lit[0])
                 for j in range(1, len(lit)):
                     ok &= b(shift(data, j) == lit[j])
                 return ok
@@ -137,7 +148,7 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                         cache[(cid, k)] = in_class(cid)
                     else:
                         prev = level(cid, k - 1)
-                        cache[(cid, k)] = prev & shift(prev, 1 << (k - 1))
+                        cache[(cid, k)] = prev & roll(prev, 1 << (k - 1))
                 return cache[(cid, k)]
 
             def window_ok(cid, n, delta):
@@ -163,6 +174,9 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                         for lo, hi in _ALNUM_INTERVALS:
                             t = b(x >= lo) & b(x <= hi)
                             a = t if a is None else (a | t)
+                        # non-alnum over the padded plane: padding zeros are
+                        # non-alnum, so a secret at file/chunk offset 0
+                        # passes the word-boundary check (match.py:173-177)
                         na = 1 - a
                     ok &= shift(na, -v.pre_len - 1)
                 col = jnp.max(ok, axis=1, keepdims=True)
@@ -192,6 +206,7 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
     def fn(chunks: jax.Array) -> jax.Array:
         B = chunks.shape[0]
         assert B % BLOCK_ROWS == 0, f"batch {B} not a multiple of {BLOCK_ROWS}"
+        padded = jnp.pad(chunks, ((0, 0), (M, M)))  # [B, Cp] real zero bytes
         partials = []
         for kern in kernels:
             partials.append(
@@ -201,13 +216,13 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                     grid=(B // BLOCK_ROWS,),
                     in_specs=[
                         pl.BlockSpec(
-                            (BLOCK_ROWS, C), lambda i: (i, 0), memory_space=pltpu.VMEM
+                            (BLOCK_ROWS, Cp), lambda i: (i, 0), memory_space=pltpu.VMEM
                         )
                     ],
                     out_specs=pl.BlockSpec(
                         (BLOCK_ROWS, R), lambda i: (i, 0), memory_space=pltpu.VMEM
                     ),
-                )(chunks)
+                )(padded)
             )
         return functools.reduce(jnp.maximum, partials).astype(bool)
 

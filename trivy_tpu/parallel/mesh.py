@@ -16,9 +16,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def get_mesh(n_devices: int | None = None, model: int = 1) -> Mesh:
-    """A ('data', 'model') mesh over the available (or first n) devices."""
-    devs = jax.devices()
+def get_mesh(n_devices: int | None = None, model: int = 1, devices=None) -> Mesh:
+    """A ('data', 'model') mesh over the given (or available, or first n)
+    devices. Pass `devices` explicitly when mixing platforms (e.g. virtual
+    CPU devices provisioned for a dry run on a TPU host)."""
+    devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
     n = len(devs)

@@ -12,15 +12,21 @@ device-checkable window lies fully inside at least one chunk — matches
 longer than the window (e.g. private-key bodies) only need their *anchor
 window* contained; the host confirm then runs over the whole file.
 
-Batches are dispatched asynchronously (JAX dispatch is async by default) with
-a depth-1 pipeline: the host packs batch N+1 while the device matches batch
-N — the TPU analog of the reference's `parallel.Pipeline` feeder/worker
-split (ref: pkg/parallel/pipeline.go:14-115).
+Batches are dispatched asynchronously (JAX dispatch is async by default)
+through a depth-PIPELINE_DEPTH pipeline: the host packs batches N+1..N+k
+while the device matches batch N — the TPU analog of the reference's
+`parallel.Pipeline` feeder/worker split (ref: pkg/parallel/pipeline.go:14-115).
+Dispatch shapes are drawn from a fixed bucket ladder (B, B/2, B/4, ...) so
+every shape compiles exactly once; exact host confirmation runs in a small
+thread pool that overlaps with the blocking device-result fetches (which
+release the GIL).
 """
 
 from __future__ import annotations
 
+from collections import deque
 from collections.abc import Iterable, Iterator
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -38,6 +44,10 @@ DEFAULT_BATCH = 64
 # pallas path: small self-contained rows, large batches (32 MB per dispatch)
 PALLAS_CHUNK_LEN = 8192
 PALLAS_BATCH = 4096
+# batches in flight before the oldest result is fetched
+PIPELINE_DEPTH = 3
+# workers for exact host confirmation (overlaps device-result waits)
+CONFIRM_WORKERS = 4
 
 
 def chunk_spans(n: int, chunk_len: int, overlap: int) -> list[int]:
@@ -108,24 +118,35 @@ class TpuSecretScanner:
             inner = sharded_match_fn(match_fn, mesh, rows_multiple=rows_mult)
             dp = inner.data_parallelism
             self._match = lambda b: inner(pad_batch(b, dp))
+            row_multiple = dp
         elif rows_mult > 1:
             self._match = lambda b: match_fn(pad_batch(b, rows_mult))
+            row_multiple = rows_mult
         else:
             self._match = match_fn
+            row_multiple = 1
+        # dispatch-shape bucket ladder: every shape compiles exactly once
+        # (variable trailing-batch shapes would recompile per distinct size)
+        buckets = [self.batch_size]
+        while buckets[-1] // 2 >= max(8, row_multiple):
+            buckets.append(buckets[-1] // 2)
+        self._buckets = sorted(buckets)
 
     # -- core batching loop -------------------------------------------------
 
     def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
         """Scan many files; yields per-file results in input order."""
-        # order-preserving result store; files resolve once all chunks matched
-        results: dict[int, Secret] = {}
+        # order-preserving result store; files resolve once all chunks
+        # matched; values are Secrets or in-flight confirmation Futures
+        results: dict[int, Secret | Future] = {}
         states: dict[int, _FileState] = {}
         next_emit = 0
         total = 0
 
         buf = np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
         meta: list[int] = []  # file index per buffered chunk
-        inflight: tuple | None = None  # (device_result, meta_snapshot)
+        inflight: deque = deque()  # (device_result, meta_snapshot)
+        pool = ThreadPoolExecutor(max_workers=CONFIRM_WORKERS)
 
         def resolve(batch_hits: np.ndarray, batch_meta: list[int]) -> None:
             for row, fidx in enumerate(batch_meta):
@@ -133,55 +154,58 @@ class TpuSecretScanner:
                 st.rules.update(np.nonzero(batch_hits[row])[0].tolist())
                 st.pending -= 1
                 if st.pending == 0:
-                    results[fidx] = self._confirm(st)
+                    results[fidx] = pool.submit(self._confirm, st)
                     del states[fidx]
 
         def flush():
-            nonlocal inflight, meta, buf
+            nonlocal meta, buf
             if not meta:
                 return
-            batch = buf[: len(meta)]
-            dev = self._match(batch)  # async dispatch
-            prev, inflight = inflight, (dev, meta)
+            n = next(b for b in self._buckets if b >= len(meta))
+            dev = self._match(buf[:n])  # async dispatch, fixed bucket shape
+            inflight.append((dev, meta))
             meta = []
             buf = np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
-            if prev is not None:
-                resolve(np.asarray(prev[0]), prev[1])
+            while len(inflight) >= PIPELINE_DEPTH:
+                d, m = inflight.popleft()
+                resolve(np.asarray(d), m)
 
         def drain() -> None:
-            nonlocal inflight
-            if inflight is not None:
-                dev, m = inflight
-                inflight = None
-                resolve(np.asarray(dev), m)
+            while inflight:
+                d, m = inflight.popleft()
+                resolve(np.asarray(d), m)
 
-        for fidx, (path, data) in enumerate(files):
-            total += 1
-            # path-level global allowlist: skip the whole file (ref:
-            # scanner.go:388-392) — no device work either
-            if self.exact.allow_path(path):
-                results[fidx] = Secret(file_path=path)
-            else:
-                starts = chunk_spans(len(data), self.chunk_len, self.overlap)
-                states[fidx] = _FileState(path=path, data=data, pending=len(starts))
-                arr = np.frombuffer(data, dtype=np.uint8)
-                for s in starts:
-                    piece = arr[s : s + self.chunk_len]
-                    buf[len(meta), : len(piece)] = piece
-                    if len(piece) < self.chunk_len:
-                        buf[len(meta), len(piece) :] = 0
-                    meta.append(fidx)
-                    if len(meta) == self.batch_size:
-                        flush()
-            # emit in order as soon as contiguous prefix is done
-            while next_emit in results:
-                yield results.pop(next_emit)
+        try:
+            for fidx, (path, data) in enumerate(files):
+                total += 1
+                # path-level global allowlist: skip the whole file (ref:
+                # scanner.go:388-392) — no device work either
+                if self.exact.allow_path(path):
+                    results[fidx] = Secret(file_path=path)
+                else:
+                    starts = chunk_spans(len(data), self.chunk_len, self.overlap)
+                    states[fidx] = _FileState(path=path, data=data, pending=len(starts))
+                    arr = np.frombuffer(data, dtype=np.uint8)
+                    for s in starts:
+                        piece = arr[s : s + self.chunk_len]
+                        buf[len(meta), : len(piece)] = piece
+                        meta.append(fidx)
+                        if len(meta) == self.batch_size:
+                            flush()
+                # emit in order as soon as the contiguous prefix is done;
+                # block on a confirmation only when it is next in line
+                while next_emit in results:
+                    r = results.pop(next_emit)
+                    yield r.result() if isinstance(r, Future) else r
+                    next_emit += 1
+            flush()  # dispatch the final partial batch
+            drain()  # resolve whatever is still in flight
+            while next_emit < total:
+                r = results.pop(next_emit)
+                yield r.result() if isinstance(r, Future) else r
                 next_emit += 1
-        flush()  # dispatch the final partial batch
-        drain()  # resolve whatever is still in flight
-        while next_emit < total:
-            yield results.pop(next_emit)
-            next_emit += 1
+        finally:
+            pool.shutdown(wait=False)
 
     def scan_bytes(self, path: str, data: bytes) -> Secret:
         """Single-file convenience (still device-prefiltered)."""
