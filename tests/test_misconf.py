@@ -1,0 +1,272 @@
+"""Misconfiguration scanning: detection, parsers, checks, e2e CLI."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from trivy_tpu.misconf import MisconfScanner, ScannerOption
+from trivy_tpu.misconf import detection
+from trivy_tpu.misconf.parse import dockerfile
+from trivy_tpu.misconf.parse.yamljson import LMap, load_all
+
+
+# -- detection ---------------------------------------------------------------
+
+def test_detect_dockerfile_names():
+    assert detection.detect_type("Dockerfile", b"FROM x") == "dockerfile"
+    assert detection.detect_type("app/Dockerfile.prod", b"FROM x") == "dockerfile"
+    assert detection.detect_type("prod.dockerfile", b"FROM x") == "dockerfile"
+    assert detection.detect_type("Containerfile", b"FROM x") == "dockerfile"
+    # stem/ext matching follows the reference: Dockerfile.<anything> counts
+    assert detection.detect_type("Dockerfile.txt", b"") == "dockerfile"
+    assert detection.detect_type("README.md", b"") is None
+
+
+def test_detect_kubernetes_vs_yaml():
+    k8s = b"apiVersion: v1\nkind: Pod\nmetadata:\n  name: x\n"
+    assert detection.detect_type("pod.yaml", k8s) == "kubernetes"
+    assert detection.detect_type("values.yaml", b"replicas: 3\n") == "yaml"
+    assert detection.detect_type("cfg.json", b'{"a": 1}') == "json"
+
+
+def test_detect_cloudformation():
+    cfn = b"AWSTemplateFormatVersion: '2010-09-09'\nResources:\n  B:\n    Type: AWS::S3::Bucket\n"
+    assert detection.detect_type("stack.yaml", cfn) == "cloudformation"
+    assert detection.detect_type("main.tf", b"") == "terraform"
+
+
+# -- dockerfile parser -------------------------------------------------------
+
+def test_dockerfile_parse_continuations_and_stages():
+    content = b"""# build
+FROM golang:1.22 AS build
+RUN go build \\
+    -o /bin/app \\
+    ./cmd
+FROM alpine:3.19
+COPY --from=build /bin/app /bin/app
+ENTRYPOINT ["/bin/app"]
+"""
+    df = dockerfile.parse(content)
+    assert [s.base for s in df.stages] == ["golang:1.22", "alpine:3.19"]
+    assert df.stages[0].name == "build"
+    run = [i for i in df.instructions if i.cmd == "RUN"][0]
+    assert run.start_line == 3 and run.end_line == 5
+    copy = [i for i in df.instructions if i.cmd == "COPY"][0]
+    assert copy.flags == {"from": "build"}
+    ep = [i for i in df.instructions if i.cmd == "ENTRYPOINT"][0]
+    assert ep.json_form and ep.args == ["/bin/app"]
+
+
+# -- yaml line tracking ------------------------------------------------------
+
+def test_yaml_line_spans():
+    docs = load_all(b"a: 1\nb:\n  c: 2\n---\nx: 9\n")
+    assert len(docs) == 2
+    d = docs[0]
+    assert isinstance(d, LMap)
+    assert d.line("a") == 1
+    assert d.line("b") == 2
+    assert d["b"].line("c") == 3
+    assert docs[1].line("x") == 5
+
+
+# -- checks ------------------------------------------------------------------
+
+def scan_one(path, content):
+    return MisconfScanner().scan_file(path, content)
+
+
+def test_dockerfile_checks_fire():
+    mc = scan_one("Dockerfile", b"""FROM alpine:latest
+MAINTAINER a@b.c
+RUN apk add curl
+RUN apt-get update
+RUN apt-get install foo
+EXPOSE 22 70000
+ADD src /app
+WORKDIR app
+USER root
+CMD ["a"]
+CMD ["b"]
+""")
+    ids = {f.id for f in mc.failures}
+    assert {
+        "DS001", "DS002", "DS004", "DS005", "DS008", "DS009",
+        "DS016", "DS017", "DS021", "DS022", "DS025", "DS026", "DS029",
+    } <= ids
+    by_id = {f.id: f for f in mc.failures}
+    assert by_id["DS002"].start_line == 9
+    assert by_id["DS022"].start_line == 2
+    # passing checks are recorded as successes
+    assert any(r.id == "DS010" for r in mc.successes)  # no sudo used
+
+
+def test_dockerfile_clean_passes():
+    mc = scan_one("Dockerfile", b"""FROM alpine:3.19
+RUN apk add --no-cache curl
+HEALTHCHECK CMD curl -f http://localhost/ || exit 1
+USER app
+COPY src /app
+WORKDIR /app
+ENTRYPOINT ["/app/run"]
+""")
+    assert [f.id for f in mc.failures] == []
+    assert len(mc.successes) >= 15
+
+
+def test_k8s_checks_fire_across_kinds():
+    deployment = b"""apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: web
+spec:
+  template:
+    spec:
+      hostPID: true
+      containers:
+      - name: app
+        image: nginx:latest
+"""
+    mc = scan_one("d.yaml", deployment)
+    ids = {f.id for f in mc.failures}
+    assert {"KSV010", "KSV013", "KSV001", "KSV011", "KSV018"} <= ids
+
+    cron = b"""apiVersion: batch/v1
+kind: CronJob
+metadata:
+  name: c
+spec:
+  jobTemplate:
+    spec:
+      template:
+        spec:
+          containers:
+          - name: job
+            image: busybox:1.36
+            securityContext:
+              privileged: true
+"""
+    mc = scan_one("c.yaml", cron)
+    assert "KSV017" in {f.id for f in mc.failures}
+
+
+def test_k8s_hardened_pod_mostly_passes():
+    pod = b"""apiVersion: v1
+kind: Pod
+metadata:
+  name: good
+spec:
+  containers:
+  - name: app
+    image: nginx:1.25.3
+    securityContext:
+      allowPrivilegeEscalation: false
+      runAsNonRoot: true
+      runAsUser: 10001
+      runAsGroup: 10001
+      readOnlyRootFilesystem: true
+      seccompProfile:
+        type: RuntimeDefault
+      capabilities:
+        drop: [ALL]
+    resources:
+      limits: {cpu: "1", memory: 1Gi}
+      requests: {cpu: 500m, memory: 512Mi}
+"""
+    mc = scan_one("p.yaml", pod)
+    assert [f.id for f in mc.failures] == []
+
+
+def test_non_workload_kinds_ignored():
+    svc = b"""apiVersion: v1
+kind: Service
+metadata:
+  name: s
+spec:
+  ports: [{port: 80}]
+"""
+    mc = scan_one("s.yaml", svc)
+    assert mc is not None and not mc.failures
+
+
+def test_disabled_check_ids():
+    s = MisconfScanner(ScannerOption(check_ids_disabled=["DS001", "DS026"]))
+    mc = s.scan_file("Dockerfile", b"FROM alpine:latest\nUSER app\n")
+    ids = {f.id for f in mc.failures} | {r.id for r in mc.successes}
+    assert "DS001" not in ids and "DS026" not in ids
+
+
+def test_multi_doc_yaml_line_attribution():
+    content = b"""apiVersion: v1
+kind: Pod
+metadata:
+  name: a
+spec:
+  hostNetwork: true
+  containers:
+  - name: c1
+    image: img:1.0
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  name: b
+spec:
+  hostNetwork: true
+  containers:
+  - name: c2
+    image: img:1.0
+"""
+    mc = scan_one("multi.yaml", content)
+    ksv9 = [f for f in mc.failures if f.id == "KSV009"]
+    assert [f.start_line for f in ksv9] == [6, 16]
+
+
+# -- e2e through artifact/driver/CLI ----------------------------------------
+
+def test_cli_misconfig_scan(tmp_path):
+    (tmp_path / "Dockerfile").write_text("FROM alpine:latest\nUSER root\n")
+    (tmp_path / "pod.yaml").write_text(
+        "apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\nspec:\n"
+        "  containers:\n  - name: c\n    image: i:1\n"
+        "    securityContext:\n      privileged: true\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", "fs", "--scanners", "misconfig",
+         "--format", "json", "--cache-dir", str(tmp_path / "c"), str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    res = {r["Target"]: r for r in doc["Results"]}
+    assert set(res) == {"Dockerfile", "pod.yaml"}
+    assert res["Dockerfile"]["Class"] == "config"
+    df_fail = [m for m in res["Dockerfile"]["Misconfigurations"] if m["Status"] == "FAIL"]
+    assert {"DS001", "DS002"} <= {m["ID"] for m in df_fail}
+    k8s_fail = [m for m in res["pod.yaml"]["Misconfigurations"] if m["Status"] == "FAIL"]
+    assert "KSV017" in {m["ID"] for m in k8s_fail}
+    # line causes propagate
+    ds2 = next(m for m in df_fail if m["ID"] == "DS002")
+    assert ds2["CauseMetadata"]["StartLine"] == 2
+
+
+def test_cli_misconfig_severity_filter(tmp_path):
+    (tmp_path / "Dockerfile").write_text("FROM alpine:3.19\nUSER app\nHEALTHCHECK CMD true\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", "fs", "--scanners", "misconfig",
+         "--format", "json", "--severity", "CRITICAL",
+         "--cache-dir", str(tmp_path / "c"), str(tmp_path)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    for r in doc.get("Results", []):
+        for m in r.get("Misconfigurations", []):
+            if m["Status"] == "FAIL":
+                assert m["Severity"] == "CRITICAL"

@@ -2,6 +2,7 @@
 (ref: each reference analyzer registers via init(), pkg/fanal/analyzer)."""
 
 from trivy_tpu.fanal.analyzers import (  # noqa: F401
+    config,
     lang,
     os_release,
     pkg_apk,

@@ -1,0 +1,55 @@
+"""CONFIG analyzer: routes IaC files to the misconfiguration scanner.
+
+The reference registers one thin config analyzer per IaC type, each
+delegating to the misconf scanner (ref: pkg/fanal/analyzer/config/*,
+config_analyzer.go). Here a single batched analyzer collects candidate
+files during the walk (cheap name prefilter) and scans them in finalize —
+keeping the walk single-pass like the secret analyzer.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    AnalyzerType,
+    BatchAnalyzer,
+    register_analyzer,
+)
+from trivy_tpu.misconf import detection
+
+# config files larger than this are data dumps, not IaC
+MAX_CONFIG_BYTES = 1 << 20
+
+
+class ConfigAnalyzer(BatchAnalyzer):
+    type = AnalyzerType.CONFIG
+    version = 1
+
+    def __init__(self, options):
+        self._files: list[tuple[str, bytes]] = []
+        self._scanner = None
+        self._disabled = list(getattr(options, "extra", {}).get(
+            "disabled_check_ids", []))
+
+    def required(self, file_path: str, info) -> bool:
+        if info.size > MAX_CONFIG_BYTES:
+            return False
+        return detection.relevant(file_path)
+
+    def collect(self, inp: AnalysisInput) -> None:
+        self._files.append((inp.file_path, inp.content))
+
+    def finalize(self) -> AnalysisResult:
+        from trivy_tpu.misconf import MisconfScanner, ScannerOption
+
+        if self._scanner is None:
+            self._scanner = MisconfScanner(
+                ScannerOption(check_ids_disabled=self._disabled)
+            )
+        files, self._files = self._files, []
+        misconfs = self._scanner.scan_files(files)
+        return AnalysisResult(misconfigurations=misconfs)
+
+
+register_analyzer(ConfigAnalyzer)

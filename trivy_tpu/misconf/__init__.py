@@ -1,0 +1,24 @@
+"""Misconfiguration (IaC) scanning.
+
+The reference's biggest subsystem (ref: pkg/misconf/scanner.go,
+pkg/iac/** — rego policy engine + per-filetype scanners). TPU-first
+stance: IaC scanning is control-flow-heavy host work with no device win
+(SURVEY.md §7 keeps it host-side), so the rego engine is replaced by a
+registry of *structured Python checks over typed inputs* — same check IDs,
+severities, and CauseMetadata/line semantics in the output, evaluated
+data-parallel over files where it matters (one pass per file, checks are
+pure functions).
+
+Layout:
+- detection:  file-type sniffing/routing (ref: pkg/iac/detection/detect.go)
+- parse:      dockerfile / yaml-json (line-tracking) / kubernetes views
+- checks:     check registry + builtin Docker (DS*) and Kubernetes (KSV*)
+              check sets (independently authored equivalents of the
+              trivy-checks bundles)
+- scanner:    facade mapping files -> [types.Misconfiguration]
+              (ref: pkg/misconf/scanner.go:141, ResultsToMisconf :443-499)
+"""
+
+from trivy_tpu.misconf.scanner import MisconfScanner, ScannerOption
+
+__all__ = ["MisconfScanner", "ScannerOption"]

@@ -1,0 +1,133 @@
+"""Check registry + evaluation engine.
+
+TPU-first replacement for the rego engine (ref: pkg/iac/rego/scanner.go):
+checks are pure Python functions over typed parsed inputs, registered with
+the same metadata surface the rego metadata blocks carry (ID, AVD ID,
+severity, title, recommended actions, url) so results render identically
+(ref: pkg/misconf/scanner.go:443-499 ResultsToMisconf).
+
+A check yields Failure records with line causes; checks that run and yield
+nothing become Successes — matching the reference's successes/failures
+split per file.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Iterator
+from dataclasses import dataclass, field
+
+from trivy_tpu.types import Misconfiguration, MisconfResult
+
+
+@dataclass
+class Failure:
+    message: str
+    start_line: int = 0
+    end_line: int = 0
+    resource: str = ""
+
+    def __post_init__(self):
+        if self.end_line < self.start_line:
+            self.end_line = self.start_line
+
+
+@dataclass(frozen=True)
+class Check:
+    id: str  # e.g. "DS002"
+    avd_id: str  # e.g. "AVD-DS-0002"
+    title: str
+    severity: str
+    file_types: tuple  # detection types this check applies to
+    fn: Callable  # (parsed_input) -> Iterator[Failure]
+    description: str = ""
+    resolution: str = ""
+    url: str = ""
+    service: str = "general"
+    provider: str = ""
+
+    @property
+    def namespace(self) -> str:
+        # stable namespace string shaped like the reference's rego namespaces
+        return f"builtin.{self.provider or self.file_types[0]}.{self.id}"
+
+
+_registry: dict[str, Check] = {}
+
+
+def register(check: Check) -> Check:
+    if check.id in _registry:
+        raise ValueError(f"check {check.id} registered twice")
+    _registry[check.id] = check
+    return check
+
+
+def checks_for(file_type: str) -> list[Check]:
+    _load_builtins()
+    return sorted(
+        (c for c in _registry.values() if file_type in c.file_types),
+        key=lambda c: c.id,
+    )
+
+
+def all_checks() -> list[Check]:
+    _load_builtins()
+    return sorted(_registry.values(), key=lambda c: c.id)
+
+
+_loaded = False
+
+
+def _load_builtins() -> None:
+    global _loaded
+    if not _loaded:
+        _loaded = True
+        import trivy_tpu.misconf.checks.docker  # noqa: F401
+        import trivy_tpu.misconf.checks.kubernetes  # noqa: F401
+
+
+def evaluate(
+    file_type: str,
+    file_path: str,
+    parsed,
+    scanner_name: str,
+    enabled: Callable[[Check], bool] = lambda c: True,
+) -> Misconfiguration | None:
+    """Run every applicable check over one parsed file."""
+    checks = [c for c in checks_for(file_type) if enabled(c)]
+    if not checks:
+        return None
+    mc = Misconfiguration(file_type=file_type, file_path=file_path)
+    for check in checks:
+        failures = list(check.fn(parsed))
+        base = dict(
+            id=check.id,
+            avd_id=check.avd_id,
+            type=f"{scanner_name} Security Check",
+            title=check.title,
+            description=check.description,
+            namespace=check.namespace,
+            query=f"data.{check.namespace}.deny",
+            resolution=check.resolution,
+            severity=check.severity,
+            primary_url=check.url,
+            references=[check.url] if check.url else [],
+            provider=check.provider,
+            service=check.service,
+        )
+        if not failures:
+            mc.successes.append(MisconfResult(status="PASS", **base))
+            continue
+        for f in failures:
+            mc.failures.append(
+                MisconfResult(
+                    status="FAIL",
+                    message=f.message,
+                    start_line=f.start_line,
+                    end_line=f.end_line,
+                    resource=f.resource,
+                    **base,
+                )
+            )
+    mc.successes.sort(key=lambda r: r.id)
+    mc.failures.sort(key=lambda r: (r.id, r.start_line, r.message))
+    return mc

@@ -1,0 +1,322 @@
+"""Builtin Kubernetes workload checks (KSV series).
+
+Independently-authored equivalents of the reference's embedded k8s check
+bundle (pod-security best practices; KSV IDs are the public interface).
+Checks walk the normalized Workload/Container views from
+``misconf.parse.kubernetes`` and report line causes from the YAML spans.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.misconf.checks import Check, Failure, register
+from trivy_tpu.misconf.parse.kubernetes import Container, Workload
+from trivy_tpu.misconf.parse.yamljson import span_of
+
+_K8S = ("kubernetes",)
+_URL = "https://avd.aquasec.com/misconfig/{}"
+
+# kinds that carry pod specs — checks are no-ops elsewhere (Service etc.)
+_WORKLOAD_KINDS = {
+    "Pod", "Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
+    "ReplicationController", "Job", "CronJob",
+}
+
+
+def _check(id_, avd, title, severity, desc="", res=""):
+    def wrap(fn):
+        def run(workloads):
+            for w in workloads:
+                if w.kind in _WORKLOAD_KINDS and w.pod_spec is not None:
+                    yield from fn(w)
+
+        register(
+            Check(
+                id=id_,
+                avd_id=avd,
+                title=title,
+                severity=severity,
+                file_types=_K8S,
+                fn=run,
+                description=desc,
+                resolution=res,
+                url=_URL.format(id_.lower()),
+                service="general",
+                provider="kubernetes",
+            )
+        )
+        return fn
+
+    return wrap
+
+
+def _cname(w: Workload, c: Container) -> str:
+    return f"{w.kind.lower()} {w.name or '<unnamed>'} container {c.name or '<unnamed>'}"
+
+
+def _cspan(c: Container):
+    s, e = span_of(c.raw)
+    return s, e
+
+
+@_check("KSV001", "AVD-KSV-0001", "Process can elevate its own privileges", "MEDIUM",
+        "A process can gain more privileges than its parent.",
+        "Set securityContext.allowPrivilegeEscalation to false.")
+def allow_priv_escalation(w: Workload):
+    for c in w.containers:
+        if c.security_context().get("allowPrivilegeEscalation") is not False:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.allowPrivilegeEscalation' to false",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV003", "AVD-KSV-0003", "Default capabilities not dropped", "LOW",
+        "Containers keep a broad default capability set.",
+        "Add 'ALL' to securityContext.capabilities.drop.")
+def drop_capabilities(w: Workload):
+    for c in w.containers:
+        caps = c.security_context().get("capabilities")
+        drop = caps.get("drop", []) if isinstance(caps, dict) else []
+        if not any(str(d).upper() == "ALL" for d in (drop or [])):
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should add 'ALL' to 'securityContext.capabilities.drop'",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV008", "AVD-KSV-0008", "Access to host IPC namespace", "HIGH",
+        "Sharing the host IPC namespace exposes host processes.",
+        "Remove 'hostIPC: true'.")
+def host_ipc(w: Workload):
+    if w.pod_spec.get("hostIPC") is True:
+        line = w.pod_spec.line("hostIPC")
+        yield Failure(
+            message=f"{w.kind} '{w.name}' should not set 'spec.hostIPC' to true",
+            start_line=line, end_line=line, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV009", "AVD-KSV-0009", "Access to host network", "HIGH",
+        "Host networking bypasses network policy.", "Remove 'hostNetwork: true'.")
+def host_network(w: Workload):
+    if w.pod_spec.get("hostNetwork") is True:
+        line = w.pod_spec.line("hostNetwork")
+        yield Failure(
+            message=f"{w.kind} '{w.name}' should not set 'spec.hostNetwork' to true",
+            start_line=line, end_line=line, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV010", "AVD-KSV-0010", "Access to host PID namespace", "HIGH",
+        "Sharing the host PID namespace exposes host processes.",
+        "Remove 'hostPID: true'.")
+def host_pid(w: Workload):
+    if w.pod_spec.get("hostPID") is True:
+        line = w.pod_spec.line("hostPID")
+        yield Failure(
+            message=f"{w.kind} '{w.name}' should not set 'spec.hostPID' to true",
+            start_line=line, end_line=line, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV011", "AVD-KSV-0011", "CPU not limited", "LOW",
+        "Unbounded CPU lets one workload starve the node.",
+        "Set resources.limits.cpu.")
+def cpu_limit(w: Workload):
+    for c in w.containers:
+        limits = c.resources().get("limits")
+        if not isinstance(limits, dict) or "cpu" not in limits:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'resources.limits.cpu'",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV012", "AVD-KSV-0012", "Runs as root user", "MEDIUM",
+        "Root in the container is root against the kernel.",
+        "Set securityContext.runAsNonRoot to true.")
+def run_as_non_root(w: Workload):
+    pod_sc = w.pod_security_context()
+    for c in w.containers:
+        sc = c.security_context()
+        if sc.get("runAsNonRoot") is not True and pod_sc.get("runAsNonRoot") is not True:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.runAsNonRoot' to true",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV013", "AVD-KSV-0013", "Image tag ':latest' used", "MEDIUM",
+        "Mutable tags make deployments unreproducible.",
+        "Use a specific image tag or digest.")
+def image_tag(w: Workload):
+    for c in w.containers:
+        image = str(c.raw.get("image", ""))
+        if not image or "@" in image:
+            continue
+        name = image.rsplit("/", 1)[-1]
+        tag = name.split(":", 1)[1] if ":" in name else ""
+        if tag == "latest" or not tag:
+            line = c.raw.line("image")
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should specify an image tag",
+                start_line=line, end_line=line, resource=_cname(w, c),
+            )
+
+
+@_check("KSV014", "AVD-KSV-0014", "Root file system is not read-only", "LOW",
+        "A writable root filesystem lets attackers persist changes.",
+        "Set securityContext.readOnlyRootFilesystem to true.")
+def read_only_root_fs(w: Workload):
+    for c in w.containers:
+        if c.security_context().get("readOnlyRootFilesystem") is not True:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.readOnlyRootFilesystem' to true",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV015", "AVD-KSV-0015", "CPU requests not specified", "LOW",
+        "Schedulers need CPU requests to place pods sanely.",
+        "Set resources.requests.cpu.")
+def cpu_requests(w: Workload):
+    for c in w.containers:
+        req = c.resources().get("requests")
+        if not isinstance(req, dict) or "cpu" not in req:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'resources.requests.cpu'",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV016", "AVD-KSV-0016", "Memory requests not specified", "LOW",
+        "Schedulers need memory requests to place pods sanely.",
+        "Set resources.requests.memory.")
+def memory_requests(w: Workload):
+    for c in w.containers:
+        req = c.resources().get("requests")
+        if not isinstance(req, dict) or "memory" not in req:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'resources.requests.memory'",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV017", "AVD-KSV-0017", "Privileged container", "HIGH",
+        "Privileged containers get every capability and host device access.",
+        "Remove 'privileged: true'.")
+def privileged(w: Workload):
+    for c in w.containers:
+        if c.security_context().get("privileged") is True:
+            line = c.security_context().line("privileged") if hasattr(
+                c.security_context(), "line") else 0
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.privileged' to false",
+                start_line=line or s, end_line=line or e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV018", "AVD-KSV-0018", "Memory not limited", "LOW",
+        "Unbounded memory invites node-level OOM kills.",
+        "Set resources.limits.memory.")
+def memory_limit(w: Workload):
+    for c in w.containers:
+        limits = c.resources().get("limits")
+        if not isinstance(limits, dict) or "memory" not in limits:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'resources.limits.memory'",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV020", "AVD-KSV-0020", "Runs with UID <= 10000", "LOW",
+        "Low UIDs may collide with host system users.",
+        "Set securityContext.runAsUser to a value > 10000.")
+def run_as_high_uid(w: Workload):
+    pod_sc = w.pod_security_context()
+    for c in w.containers:
+        sc = c.security_context()
+        uid = sc.get("runAsUser", pod_sc.get("runAsUser"))
+        if uid is None or (isinstance(uid, int) and uid <= 10000):
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.runAsUser' > 10000",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV021", "AVD-KSV-0021", "Runs with GID <= 10000", "LOW",
+        "Low GIDs may collide with host system groups.",
+        "Set securityContext.runAsGroup to a value > 10000.")
+def run_as_high_gid(w: Workload):
+    pod_sc = w.pod_security_context()
+    for c in w.containers:
+        sc = c.security_context()
+        gid = sc.get("runAsGroup", pod_sc.get("runAsGroup"))
+        if gid is None or (isinstance(gid, int) and gid <= 10000):
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.runAsGroup' > 10000",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV023", "AVD-KSV-0023", "hostPath volume mounted", "MEDIUM",
+        "hostPath mounts pierce the container filesystem boundary.",
+        "Do not mount hostPath volumes.")
+def host_path(w: Workload):
+    vols = w.pod_spec.get("volumes")
+    if not isinstance(vols, list):
+        return
+    for v in vols:
+        if isinstance(v, dict) and "hostPath" in v:
+            s, e = span_of(v, w.pod_spec.span)
+            yield Failure(
+                message=f"{w.kind} '{w.name}' should not set 'spec.volumes[*].hostPath'",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_check("KSV030", "AVD-KSV-0030", "Runtime/default seccomp profile not set", "LOW",
+        "Without a seccomp profile the syscall surface is unrestricted.",
+        "Set securityContext.seccompProfile.type to RuntimeDefault.")
+def seccomp(w: Workload):
+    pod_sc = w.pod_security_context()
+    pod_prof = pod_sc.get("seccompProfile")
+    pod_ok = isinstance(pod_prof, dict) and pod_prof.get("type") in (
+        "RuntimeDefault", "Localhost")
+    for c in w.containers:
+        prof = c.security_context().get("seccompProfile")
+        ok = isinstance(prof, dict) and prof.get("type") in (
+            "RuntimeDefault", "Localhost")
+        if not ok and not pod_ok:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should set 'securityContext.seccompProfile.type' to 'RuntimeDefault'",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV106", "AVD-KSV-0106", "Container capabilities must only include NET_BIND_SERVICE", "LOW",
+        "Restricted pod security standard allows only NET_BIND_SERVICE adds.",
+        "Drop ALL capabilities and add only NET_BIND_SERVICE if needed.")
+def restricted_capabilities(w: Workload):
+    for c in w.containers:
+        caps = c.security_context().get("capabilities")
+        add = caps.get("add", []) if isinstance(caps, dict) else []
+        bad = [str(a) for a in (add or []) if str(a).upper() not in ("NET_BIND_SERVICE",)]
+        if bad:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' adds disallowed capabilities {bad}",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )

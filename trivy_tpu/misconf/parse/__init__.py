@@ -1,0 +1,1 @@
+"""IaC parsers: typed inputs for the check engine."""

@@ -78,8 +78,8 @@ def _write_result(result: Result, out) -> None:
                 out.write(f"{line.number:>4} {marker} {line.content}\n")
             out.write(_rule(40) + "\n")
     if result.misconfigurations:
-        counter = Counter(m.severity for m in result.misconfigurations)
         fails = [m for m in result.misconfigurations if m.status == "FAIL"]
+        counter = Counter(m.severity for m in fails)
         _header(
             out,
             f"{result.target} ({result.type})",

@@ -215,6 +215,76 @@ class SecretScanner:
             ]
         return locs
 
+    def find_rule_locations_in_windows(
+        self,
+        rule: Rule,
+        content: str,
+        lower: str,
+        windows: list[tuple[int, int]],
+        global_blocks: list[tuple[int, int]] | None = None,
+    ) -> list[Location]:
+        """Same results as :meth:`find_rule_locations` restricted to matches
+        anchored inside the given windows (the device-flagged chunk spans).
+
+        Uses ``finditer(pos, endpos)`` rather than slicing so ``^``,
+        lookbehind and word-prefix alternations see the *real* surrounding
+        context; windows are padded by the rule's max match width (falling
+        back to a full scan for unbounded-width rules), which both admits
+        matches straddling a window edge and preserves the engine's
+        non-overlapping-match consumption order.
+        """
+        if not rule.match_keywords(lower):  # keywords are a whole-file test
+            return []
+        wmax = rule.max_match_width
+        if wmax is None or wmax > 8192:
+            return self.find_rule_locations(rule, content, lower, global_blocks)
+        n = len(content)
+        pad = wmax + 256  # slack for short lookarounds beyond the match
+        ivs = sorted((max(0, s - pad), min(n, e + pad)) for s, e in windows)
+        merged: list[list[int]] = []
+        for s, e in ivs:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        locs: list[Location] = []
+        for s, e in merged:
+            for m in rule.regex_re.finditer(content, s, e):
+                if (
+                    rule.secret_group_name
+                    and rule.secret_group_name in rule.regex_re.groupindex
+                ):
+                    start, end = m.span(rule.secret_group_name)
+                else:
+                    start, end = m.span()
+                if start == end or start < 0:
+                    continue
+                locs.append(Location(start, end))
+        if not locs:
+            return []
+        # exclude blocks and allow regexes replicate find_rule_locations over
+        # the full content (a block straddling a window must still suppress)
+        blocks: list[tuple[int, int]] = list(
+            global_blocks if global_blocks is not None else self.global_block_spans(content)
+        )
+        for pat in rule.exclude_block_res:
+            blocks.extend(m.span() for m in pat.finditer(content))
+        if blocks:
+            locs = [
+                l
+                for l in locs
+                if not any(bs <= l.start and l.end <= be for bs, be in blocks)
+            ]
+        allow_res = [a.regex_re for a in rule.allow_rules if a.regex_re is not None]
+        allow_res += [a.regex_re for a in self.allow_rules if a.regex_re is not None]
+        if allow_res:
+            locs = [
+                l
+                for l in locs
+                if not any(p.search(content[l.start : l.end]) for p in allow_res)
+            ]
+        return locs
+
     # -- full scan ----------------------------------------------------------
 
     def scan_bytes(self, file_path: str, data: bytes) -> Secret:

@@ -89,6 +89,18 @@ class Rule:
         return [re.compile(p) for p in self.exclude_blocks]
 
     @cached_property
+    def max_match_width(self) -> int | None:
+        """Upper bound on a match's length in chars, or None if unbounded
+        (used to size span-restricted confirmation windows)."""
+        try:
+            import re._parser as sre_parse
+
+            _, hi = sre_parse.parse(self.regex).getwidth()
+            return None if hi >= sre_parse.MAXWIDTH else int(hi)
+        except Exception:
+            return None
+
+    @cached_property
     def lower_keywords(self) -> list[str]:
         return [k.lower() for k in self.keywords]
 

@@ -64,7 +64,8 @@ class _FileState:
     path: str
     data: bytes
     pending: int  # chunks not yet matched
-    rules: set[int] = field(default_factory=set)  # candidate rule indices
+    # candidate rule index -> chunk windows (byte spans) where it hit
+    rules: dict[int, list[tuple[int, int]]] = field(default_factory=dict)
 
 
 class TpuSecretScanner:
@@ -148,10 +149,16 @@ class TpuSecretScanner:
         inflight: deque = deque()  # (device_result, meta_snapshot)
         pool = ThreadPoolExecutor(max_workers=CONFIRM_WORKERS)
 
-        def resolve(batch_hits: np.ndarray, batch_meta: list[int]) -> None:
-            for row, fidx in enumerate(batch_meta):
+        def resolve(batch_hits: np.ndarray, batch_meta: list) -> None:
+            # one vectorized nonzero per batch, not one per row
+            rows, ridx = np.nonzero(batch_hits[: len(batch_meta)])
+            for row, r in zip(rows.tolist(), ridx.tolist()):
+                fidx, start = batch_meta[row]
+                states[fidx].rules.setdefault(r, []).append(
+                    (start, start + self.chunk_len)
+                )
+            for fidx, _ in batch_meta:
                 st = states[fidx]
-                st.rules.update(np.nonzero(batch_hits[row])[0].tolist())
                 st.pending -= 1
                 if st.pending == 0:
                     results[fidx] = pool.submit(self._confirm, st)
@@ -189,7 +196,7 @@ class TpuSecretScanner:
                     for s in starts:
                         piece = arr[s : s + self.chunk_len]
                         buf[len(meta), : len(piece)] = piece
-                        meta.append(fidx)
+                        meta.append((fidx, s))
                         if len(meta) == self.batch_size:
                             flush()
                 # emit in order as soon as the contiguous prefix is done;
@@ -214,19 +221,27 @@ class TpuSecretScanner:
     # -- host confirmation --------------------------------------------------
 
     def _confirm(self, st: _FileState) -> Secret:
-        candidate_ids = {self.compiled.rule_ids[i] for i in st.rules}
-        candidate_ids.update(self.compiled.host_rule_ids)
-        if not candidate_ids:
+        windows_by_id = {
+            self.compiled.rule_ids[i]: w for i, w in st.rules.items()
+        }
+        host_ids = set(self.compiled.host_rule_ids)
+        if not windows_by_id and not host_ids:
             return Secret(file_path=st.path)
         content = st.data.decode("latin-1")
         lower = content.lower()
         global_blocks = self.exact.global_block_spans(content)
         hits = []
         for rule in self.exact.rules_for_path(st.path):
-            if rule.id not in candidate_ids:
+            if rule.id in windows_by_id:
+                # regex runs only around the device-flagged chunk windows
+                locs = self.exact.find_rule_locations_in_windows(
+                    rule, content, lower, windows_by_id[rule.id], global_blocks
+                )
+            elif rule.id in host_ids:
+                locs = self.exact.find_rule_locations(
+                    rule, content, lower, global_blocks
+                )
+            else:
                 continue
-            for loc in self.exact.find_rule_locations(
-                rule, content, lower, global_blocks
-            ):
-                hits.append((rule, loc))
+            hits.extend((rule, loc) for loc in locs)
         return self.exact.build_findings(st.path, content, hits)
