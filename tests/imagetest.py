@@ -1,0 +1,121 @@
+"""Fixture image-archive builder (docker-save and OCI layout), mirroring the
+reference's fake-image technique (ref: internal/dbtest/fake.go wraps tar
+layers in a fake image so image paths are tested without a daemon)."""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import tarfile
+
+
+def tar_bytes(files: dict[str, bytes]) -> bytes:
+    """Uncompressed tar with the given {path: content} regular files."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for name, content in files.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            info.mode = 0o644
+            tf.addfile(info, io.BytesIO(content))
+    return buf.getvalue()
+
+
+def _sha(b: bytes) -> str:
+    return "sha256:" + hashlib.sha256(b).hexdigest()
+
+
+def build_config(diff_ids: list[str], history=None, env=None) -> bytes:
+    cfg = {
+        "architecture": "amd64",
+        "os": "linux",
+        "created": "2024-01-01T00:00:00Z",
+        "rootfs": {"type": "layers", "diff_ids": diff_ids},
+        "history": history or [
+            {"created_by": f"/bin/sh -c #(nop) LAYER {i}"} for i in range(len(diff_ids))
+        ],
+        "config": {"Env": env or ["PATH=/usr/bin"]},
+    }
+    return json.dumps(cfg).encode()
+
+
+def docker_save_tar(path, layers: list[bytes], history=None, env=None,
+                    repo_tag="fixture:latest") -> str:
+    """Write a docker-save archive; returns the image path."""
+    diff_ids = [_sha(l) for l in layers]
+    config = build_config(diff_ids, history, env)
+    cfg_name = hashlib.sha256(config).hexdigest() + ".json"
+    layer_names = [f"layer{i}/layer.tar" for i in range(len(layers))]
+    manifest = json.dumps(
+        [{"Config": cfg_name, "RepoTags": [repo_tag], "Layers": layer_names}]
+    ).encode()
+    with tarfile.open(path, "w") as tf:
+        for name, content in [
+            ("manifest.json", manifest),
+            (cfg_name, config),
+            *zip(layer_names, layers),
+        ]:
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+    return str(path)
+
+
+def oci_layout_dir(path, layers: list[bytes], history=None, env=None,
+                   compress=True) -> str:
+    """Write an OCI image layout directory; returns the path."""
+    import os
+
+    blobs = os.path.join(path, "blobs", "sha256")
+    os.makedirs(blobs, exist_ok=True)
+
+    def put(b: bytes) -> str:
+        digest = _sha(b)
+        with open(os.path.join(blobs, digest.split(":")[1]), "wb") as f:
+            f.write(b)
+        return digest
+
+    diff_ids = [_sha(l) for l in layers]
+    stored = [gzip.compress(l) if compress else l for l in layers]
+    layer_descs = [
+        {
+            "mediaType": "application/vnd.oci.image.layer.v1.tar"
+            + (".gzip" if compress else ""),
+            "digest": put(s),
+            "size": len(s),
+        }
+        for s in stored
+    ]
+    config = build_config(diff_ids, history, env)
+    cfg_digest = put(config)
+    manifest = json.dumps(
+        {
+            "schemaVersion": 2,
+            "config": {
+                "mediaType": "application/vnd.oci.image.config.v1+json",
+                "digest": cfg_digest,
+                "size": len(config),
+            },
+            "layers": layer_descs,
+        }
+    ).encode()
+    man_digest = put(manifest)
+    with open(os.path.join(path, "index.json"), "w") as f:
+        json.dump(
+            {
+                "schemaVersion": 2,
+                "manifests": [
+                    {
+                        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+                        "digest": man_digest,
+                        "size": len(manifest),
+                    }
+                ],
+            },
+            f,
+        )
+    with open(os.path.join(path, "oci-layout"), "w") as f:
+        json.dump({"imageLayoutVersion": "1.0.0"}, f)
+    return str(path)

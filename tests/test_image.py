@@ -1,0 +1,165 @@
+"""Container-image scanning: tar walker, archive reader, layer pipeline,
+whiteout semantics, imgconf analysis, CLI."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.imagetest import docker_save_tar, oci_layout_dir, tar_bytes
+
+GHP = "ghp_" + "A1b2C3d4E5f6G7h8I9j0K1l2M3n4O5p6Q7r8"
+GHP2 = "ghp_" + "Z9y8X7w6V5u4T3s2R1q0P9o8N7m6L5k4J3i2"
+
+OS_RELEASE = b'ID=alpine\nVERSION_ID=3.18.4\nPRETTY_NAME="Alpine Linux v3.18"\n'
+APK_DB = b"""C:Q1abc=
+P:musl
+V:1.2.3-r0
+A:x86_64
+
+C:Q2def=
+P:busybox
+V:1.36.1-r0
+A:x86_64
+
+"""
+
+
+def _layers():
+    l1 = tar_bytes({
+        "etc/os-release": OS_RELEASE,
+        "lib/apk/db/installed": APK_DB,
+        "app/secret.txt": f"token {GHP}\n".encode(),
+        "app/sub/old.txt": f"legacy {GHP2}\n".encode(),
+    })
+    l2 = tar_bytes({
+        "app/.wh.secret.txt": b"",          # whiteout: deletes app/secret.txt
+        "app/sub/.wh..wh..opq": b"",        # opaque: hides app/sub contents
+        "new/cred.txt": f"x {GHP2} y\n".encode(),
+    })
+    return [l1, l2]
+
+
+def scan_image(path, cache_dir, scanners=("secret",)):
+    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    cache = new_cache("fs", str(cache_dir))
+    artifact = ImageArchiveArtifact(str(path), cache, ArtifactOption(backend="cpu"))
+    driver = LocalDriver(cache)
+    return Scanner(artifact, driver).scan_artifact(ScanOptions(scanners=list(scanners)))
+
+
+def test_tar_walker_whiteouts():
+    from trivy_tpu.fanal.walker_tar import LayerResult, LayerTarWalker
+
+    res = LayerResult()
+    walker = LayerTarWalker()
+    files = {
+        rel: opener()
+        for rel, info, opener in walker.walk(io.BytesIO(_layers()[1]), res)
+    }
+    assert list(files) == ["new/cred.txt"]
+    assert res.whiteout_files == ["app/secret.txt"]
+    assert res.opaque_dirs == ["app/sub"]
+
+
+def test_docker_save_whiteout_semantics(tmp_path):
+    img = docker_save_tar(tmp_path / "img.tar", _layers())
+    report = scan_image(img, tmp_path / "cache")
+    targets = {r.target for r in report.results}
+    # both layer-1 secrets are deleted by layer 2 (whiteout + opaque dir);
+    # image-layer secret paths carry the reference's leading '/'
+    assert "/app/secret.txt" not in targets
+    assert "/app/sub/old.txt" not in targets
+    assert "/new/cred.txt" in targets
+    assert report.artifact_type == "container_image"
+    assert report.artifact_name == "fixture:latest"
+    assert len(report.metadata["DiffIDs"]) == 2
+    # layer attribution on the surviving finding
+    cred = next(r for r in report.results if r.target == "/new/cred.txt")
+    assert cred.secrets[0].layer == report.metadata["DiffIDs"][1]
+
+
+def test_oci_layout_gzip_layers(tmp_path):
+    img = oci_layout_dir(tmp_path / "oci", _layers(), compress=True)
+    report = scan_image(img, tmp_path / "cache")
+    targets = {r.target for r in report.results}
+    assert "/new/cred.txt" in targets and "/app/secret.txt" not in targets
+
+
+def test_image_vuln_scan_alpine(tmp_path):
+    from tests.dbtest import build_db
+    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.db import VulnDB
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    img = docker_save_tar(tmp_path / "img.tar", _layers())
+    cache = new_cache("fs", str(tmp_path / "cache"))
+    artifact = ImageArchiveArtifact(img, cache, ArtifactOption(backend="cpu"))
+    db = VulnDB.load(build_db(tmp_path))
+    driver = LocalDriver(cache, vuln_client=db)
+    report = Scanner(artifact, driver).scan_artifact(ScanOptions(scanners=["vuln"]))
+    vuln_result = next(r for r in report.results if r.vulnerabilities)
+    ids = {v.vulnerability_id for v in vuln_result.vulnerabilities}
+    # alpine 3.18.4 normalizes to the 'alpine 3.18' bucket
+    assert "CVE-2023-0001" in ids
+    # OS identity surfaced in metadata
+    assert report.metadata["OS"]["Family"] == "alpine"
+
+
+def test_layer_cache_reuse(tmp_path):
+    from trivy_tpu.cache import new_cache
+
+    img = docker_save_tar(tmp_path / "img.tar", _layers())
+    r1 = scan_image(img, tmp_path / "cache")
+    # second scan: all layer blobs cached; results identical
+    r2 = scan_image(img, tmp_path / "cache")
+    strip = lambda d: {k: v for k, v in d.items() if k != "CreatedAt"}
+    assert strip(r1.to_dict()) == strip(r2.to_dict())
+
+
+def test_imgconf_history_misconf_and_env_secret(tmp_path):
+    history = [
+        {"created_by": "/bin/sh -c #(nop) FROM alpine:latest"},
+        {"created_by": "/bin/sh -c apk add curl"},
+        {"created_by": "/bin/sh -c #(nop) USER root", "empty_layer": True},
+    ]
+    env = ["PATH=/usr/bin", f"GITHUB_TOKEN={GHP}"]
+    img = docker_save_tar(
+        tmp_path / "img.tar", [tar_bytes({"a.txt": b"hello there"})],
+        history=history, env=env,
+    )
+    report = scan_image(img, tmp_path / "cache", scanners=("secret", "misconfig"))
+    by_target = {r.target: r for r in report.results}
+    env_res = by_target.get("container image config (env)")
+    assert env_res and env_res.secrets[0].rule_id == "github-pat"
+    hist = by_target.get("Dockerfile (image history)")
+    assert hist is not None
+    ids = {m.id for m in hist.misconfigurations if m.status == "FAIL"}
+    assert "DS002" in ids  # USER root from history
+    assert "DS025" in ids  # apk add without --no-cache
+
+
+def test_cli_image_scan(tmp_path):
+    img = docker_save_tar(tmp_path / "img.tar", _layers())
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", "image", "--scanners", "secret",
+         "--backend", "cpu", "--format", "json", "--input", img,
+         "--cache-dir", str(tmp_path / "c")],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["ArtifactType"] == "container_image"
+    assert "/new/cred.txt" in {r["Target"] for r in doc["Results"]}

@@ -1,0 +1,256 @@
+"""Container-image artifact over docker-save / OCI-layout archives
+(ref: pkg/fanal/artifact/image/image.go:56-231, pkg/fanal/image/archive.go).
+
+Per-layer pipeline: diff-ID → cache key (analyzer versions included), a
+``MissingBlobs`` diff so cached layers are never re-walked, then each
+missing layer is tar-walked (whiteout/opaque collection) and analyzed.
+Image-config analysis (ENV secrets + history-as-Dockerfile misconfig, ref:
+pkg/fanal/analyzer/imgconf) is emitted as one synthetic top blob so the
+standard applier/driver path surfaces it — a deliberate simplification of
+the reference's separate artifact-bucket plumbing.
+
+Daemon/registry sources (docker/containerd/podman pulls) are out of scope
+in this environment (zero egress); the archive reader covers `docker save`
+tars, OCI layout dirs, and OCI layout tars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tarfile
+
+from trivy_tpu import log
+from trivy_tpu.artifact.local_fs import ArtifactOption
+from trivy_tpu.cache.key import calc_key
+from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
+from trivy_tpu.fanal.handler import HandlerManager
+from trivy_tpu.fanal.walker_tar import LayerResult, LayerTarWalker
+from trivy_tpu.types import ArtifactReference, BlobInfo
+
+logger = log.logger("artifact:image")
+
+
+class _ImageArchive:
+    """Random access to a docker-save or OCI-layout archive (dir or tar)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tar: tarfile.TarFile | None = None
+        if os.path.isdir(path):
+            self._read = self._read_dir
+        else:
+            self._tar = tarfile.open(path)
+            self._read = self._read_tar
+        self.name = os.path.basename(path.rstrip("/"))
+        self._load()
+
+    def close(self):
+        if self._tar is not None:
+            self._tar.close()
+
+    def _read_dir(self, member: str) -> bytes:
+        with open(os.path.join(self.path, member), "rb") as f:
+            return f.read()
+
+    def _read_tar(self, member: str) -> bytes:
+        for cand in (member, f"./{member}"):
+            try:
+                f = self._tar.extractfile(cand)
+            except KeyError:
+                continue
+            if f is not None:
+                return f.read()
+        raise KeyError(f"archive member not found: {member}")
+
+    def _exists(self, member: str) -> bool:
+        try:
+            self._read(member)
+            return True
+        except (KeyError, FileNotFoundError):
+            return False
+
+    @staticmethod
+    def _blob_path(digest: str) -> str:
+        algo, _, hexd = digest.partition(":")
+        return f"blobs/{algo}/{hexd}"
+
+    def _load(self) -> None:
+        if self._exists("manifest.json"):
+            self._load_docker_save()
+        elif self._exists("index.json"):
+            self._load_oci()
+        else:
+            raise ValueError(
+                f"{self.path}: neither docker-save (manifest.json) nor "
+                "OCI layout (index.json)"
+            )
+
+    def _load_docker_save(self) -> None:
+        manifest = json.loads(self._read("manifest.json"))[0]
+        self.config_bytes = self._read(manifest["Config"])
+        self.config = json.loads(self.config_bytes)
+        tags = manifest.get("RepoTags") or []
+        if tags:
+            self.name = tags[0]
+        self._layer_paths = list(manifest["Layers"])
+
+    def _load_oci(self) -> None:
+        desc = json.loads(self._read("index.json"))["manifests"][0]
+        blob = json.loads(self._read(self._blob_path(desc["digest"])))
+        while "manifests" in blob:  # nested image index → first platform
+            blob = json.loads(
+                self._read(self._blob_path(blob["manifests"][0]["digest"]))
+            )
+        self.config_bytes = self._read(self._blob_path(blob["config"]["digest"]))
+        self.config = json.loads(self.config_bytes)
+        self._layer_paths = [self._blob_path(l["digest"]) for l in blob["layers"]]
+
+    @property
+    def image_id(self) -> str:
+        import hashlib
+
+        return f"sha256:{hashlib.sha256(self.config_bytes).hexdigest()}"
+
+    @property
+    def diff_ids(self) -> list[str]:
+        return list(self.config.get("rootfs", {}).get("diff_ids", []))
+
+    def layer_stream(self, index: int):
+        """Readable file object for layer ``index``'s (possibly compressed)
+        tar."""
+        member = self._layer_paths[index]
+        if self._tar is None:
+            return open(os.path.join(self.path, member), "rb")
+        for cand in (member, f"./{member}"):
+            try:
+                f = self._tar.extractfile(cand)
+            except KeyError:
+                continue
+            if f is not None:
+                return f
+        raise KeyError(f"layer not found: {member}")
+
+    def layer_history(self) -> list[dict]:
+        """History entries aligned to diff_ids (empty_layer entries skipped)."""
+        out = []
+        for h in self.config.get("history", []):
+            if not h.get("empty_layer"):
+                out.append(h)
+        return out
+
+
+class ImageArchiveArtifact:
+    type = "container_image"
+
+    def __init__(self, path: str, cache, option: ArtifactOption | None = None):
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"image archive not found: {path} (daemon/registry pulls are "
+                "not supported in this build; use 'docker save' output or an "
+                "OCI layout)"
+            )
+        self.path = path
+        self.cache = cache
+        self.option = option or ArtifactOption()
+        self.group = AnalyzerGroup(
+            AnalyzerOptions(
+                disabled=self.option.disabled_analyzers,
+                secret_config_path=self.option.secret_config_path,
+                backend=self.option.backend,
+            )
+        )
+        self.handlers = HandlerManager()
+        self.walker = LayerTarWalker(
+            skip_files=self.option.skip_files, skip_dirs=self.option.skip_dirs
+        )
+
+    # -- per-layer analysis --------------------------------------------------
+
+    def _analyze_layer(self, archive: _ImageArchive, index: int,
+                       diff_id: str, created_by: str) -> BlobInfo:
+        result = AnalysisResult()
+        post_files: dict = {}
+        layer_res = LayerResult()
+        stream = archive.layer_stream(index)
+        try:
+            for rel, info, opener in self.walker.walk(stream, layer_res):
+                wanted = self.group.analyze_file(result, "", rel, info, opener)
+                for t, content in wanted.items():
+                    post_files.setdefault(t, {})[rel] = content
+        finally:
+            stream.close()
+        self.group.finalize(result, post_files)
+        blob = result.to_blob_info()
+        self.handlers.post_handle(result, blob)
+        blob.diff_id = diff_id
+        blob.created_by = created_by
+        blob.whiteout_files = sorted(layer_res.whiteout_files)
+        blob.opaque_dirs = sorted(layer_res.opaque_dirs)
+        return blob
+
+    def _analyze_config(self, archive: _ImageArchive) -> BlobInfo:
+        """Image-config analysis as a synthetic top blob (imgconf analog)."""
+        from trivy_tpu.fanal.analyzers.imgconf import analyze_image_config
+
+        blob = analyze_image_config(archive.config, self.option)
+        blob.diff_id = archive.image_id
+        return blob
+
+    # -- inspect -------------------------------------------------------------
+
+    def inspect(self) -> ArtifactReference:
+        archive = _ImageArchive(self.path)
+        try:
+            versions = self.group.versions()
+            hooks = self.handlers.versions()
+            diff_ids = archive.diff_ids
+            history = archive.layer_history()
+
+            def key(base: str) -> str:
+                return calc_key(
+                    base,
+                    analyzer_versions=versions,
+                    hook_versions=hooks,
+                    skip_files=self.option.skip_files,
+                    skip_dirs=self.option.skip_dirs,
+                )
+
+            layer_keys = [key(d) for d in diff_ids]
+            config_key = key(archive.image_id + "/config")
+            blob_ids = layer_keys + [config_key]
+            artifact_key = key(archive.image_id)
+
+            _, missing = self.cache.missing_blobs(artifact_key, blob_ids)
+            missing_set = set(missing)
+            for i, (diff_id, lkey) in enumerate(zip(diff_ids, layer_keys)):
+                if lkey not in missing_set:
+                    continue
+                created_by = (
+                    history[i].get("created_by", "") if i < len(history) else ""
+                )
+                blob = self._analyze_layer(archive, i, diff_id, created_by)
+                self.cache.put_blob(lkey, blob.to_dict())
+            if config_key in missing_set:
+                blob = self._analyze_config(archive)
+                self.cache.put_blob(config_key, blob.to_dict())
+
+            cfg = archive.config
+            return ArtifactReference(
+                name=archive.name,
+                type=self.type,
+                id=artifact_key,
+                blob_ids=blob_ids,
+                image_metadata={
+                    "id": archive.image_id,
+                    "diff_ids": diff_ids,
+                    "config": {
+                        "architecture": cfg.get("architecture", ""),
+                        "created": cfg.get("created", ""),
+                        "os": cfg.get("os", ""),
+                        "config": cfg.get("config", {}),
+                    },
+                },
+            )
+        finally:
+            archive.close()

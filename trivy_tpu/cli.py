@@ -175,6 +175,12 @@ def build_parser() -> argparse.ArgumentParser:
         elif cmd == "clean":
             p.add_argument("--all", action="store_true", dest="clean_all")
             p.add_argument("--scan-cache", action="store_true")
+        elif cmd == "image":
+            # ref: trivy image --input for archives; positional for names
+            p.add_argument("--input", default=None,
+                           help="image archive (docker save tar / OCI layout)")
+            p.add_argument("target", nargs="?", default=None,
+                           help="image archive path")
         else:
             p.add_argument("target", help="scan target")
 

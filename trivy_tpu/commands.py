@@ -176,8 +176,12 @@ def _run_image(ns, opts) -> int:
     from trivy_tpu.artifact.image import ImageArchiveArtifact
     from trivy_tpu.scanner.local_driver import LocalDriver
 
+    target = getattr(ns, "input", None) or ns.target
+    if not target:
+        logger.error("specify an image archive path (positional or --input)")
+        return 1
     cache = _make_cache(opts)
-    artifact = ImageArchiveArtifact(ns.target, cache, _artifact_option(ns, opts))
+    artifact = ImageArchiveArtifact(target, cache, _artifact_option(ns, opts))
     driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
     report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)

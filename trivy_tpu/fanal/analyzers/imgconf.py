@@ -1,0 +1,82 @@
+"""Image-config analysis (ref: pkg/fanal/analyzer/imgconf/{secret,dockerfile}).
+
+Analyzes the container *config* rather than layer contents: environment
+variables are scanned for secrets, and the build history is reconstructed
+into a Dockerfile and run through the Dockerfile misconfiguration checks —
+the same two signals the reference extracts from image configs.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.types import BlobInfo
+
+# pseudo-paths for config-derived findings (rendered as scan targets)
+ENV_TARGET = "container image config (env)"
+HISTORY_TARGET = "Dockerfile (image history)"
+
+
+def history_to_dockerfile(config: dict) -> str:
+    """Reconstruct an approximate Dockerfile from config history
+    (ref: imgconf/dockerfile/dockerfile.go builds scanner input the same
+    way: each created_by entry becomes an instruction)."""
+    lines = []
+    for h in config.get("history", []):
+        cmd = (h.get("created_by") or "").strip()
+        if not cmd:
+            continue
+        # strip the classic builder prefixes
+        for prefix in ("/bin/sh -c #(nop) ", "/bin/sh -c #(nop)"):
+            if cmd.startswith(prefix):
+                cmd = cmd[len(prefix):].strip()
+                break
+        else:
+            if cmd.startswith("/bin/sh -c "):
+                cmd = "RUN " + cmd[len("/bin/sh -c "):]
+        # buildkit style: "RUN /bin/sh -c cmd # buildkit"
+        if cmd.endswith("# buildkit"):
+            cmd = cmd[: -len("# buildkit")].strip()
+        first = cmd.split(" ", 1)[0].upper()
+        known = {
+            "FROM", "RUN", "CMD", "LABEL", "MAINTAINER", "EXPOSE", "ENV",
+            "ADD", "COPY", "ENTRYPOINT", "VOLUME", "USER", "WORKDIR", "ARG",
+            "ONBUILD", "STOPSIGNAL", "HEALTHCHECK", "SHELL",
+        }
+        if first not in known:
+            cmd = f"RUN {cmd}"
+        lines.append(cmd)
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def analyze_image_config(config: dict, option) -> BlobInfo:
+    blob = BlobInfo()
+
+    # ENV secrets (ref: imgconf/secret — env vars as scannable content)
+    envs = config.get("config", {}).get("Env") or []
+    if envs and "secret" not in {
+        getattr(t, "value", t) for t in option.disabled_analyzers
+    }:
+        from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
+
+        cfg = None
+        if option.secret_config_path:
+            import os.path
+
+            if os.path.exists(option.secret_config_path):
+                cfg = ScannerConfig.from_yaml_file(option.secret_config_path)
+        scanner = SecretScanner(cfg)
+        content = "\n".join(str(e) for e in envs).encode()
+        secret = scanner.scan_bytes(ENV_TARGET, content)
+        if secret.findings:
+            blob.secrets.append(secret)
+
+    # history misconfig (ref: imgconf/dockerfile)
+    if "config" not in {getattr(t, "value", t) for t in option.disabled_analyzers}:
+        dockerfile_text = history_to_dockerfile(config)
+        if dockerfile_text:
+            from trivy_tpu.misconf import MisconfScanner
+
+            mc = MisconfScanner().scan_file("Dockerfile", dockerfile_text.encode())
+            if mc is not None and (mc.failures or mc.successes):
+                mc.file_path = HISTORY_TARGET
+                blob.misconfigurations.append(mc)
+    return blob

@@ -12,6 +12,10 @@ from trivy_tpu.types import ArtifactDetail, BlobInfo
 
 
 def _deleted_by_whiteouts(path: str, whiteouts: list[str], opaques: list[str]) -> bool:
+    # secret/license paths from image layers carry a display-leading '/'
+    # (ref: analyzer/secret secret.go:131-137); whiteout entries are raw tar
+    # paths — compare both without the prefix
+    path = path.lstrip("/")
     if path in whiteouts:
         return True
     return any(path == od or path.startswith(od.rstrip("/") + "/") for od in opaques)
