@@ -172,6 +172,10 @@ def build_parser() -> argparse.ArgumentParser:
         if cmd == "server":
             p.add_argument("--listen", default="0.0.0.0:4954",
                            help="listen address")
+            p.add_argument("--token", default="",
+                           help="auth token required from clients")
+            p.add_argument("--token-header", default="Trivy-Token",
+                           help="header carrying the auth token")
         elif cmd == "clean":
             p.add_argument("--all", action="store_true", dest="clean_all")
             p.add_argument("--scan-cache", action="store_true")

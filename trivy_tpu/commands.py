@@ -57,10 +57,15 @@ def _artifact_option(ns, opts):
 
 
 def _scan_options(opts) -> ScanOptions:
+    # SBOM/snapshot formats need the full package inventory (ref:
+    # flag/report_flags.go forces ListAllPkgs for sbom formats)
+    list_all = bool(opts.get("list_all_pkgs")) or opts.get("format") in (
+        "cyclonedx", "spdx", "spdx-json", "github",
+    )
     return ScanOptions(
         scanners=opts.get("scanners", ["secret"]),
         license_full=bool(opts.get("license_full")),
-        list_all_pkgs=bool(opts.get("list_all_pkgs")),
+        list_all_pkgs=list_all,
     )
 
 
@@ -147,7 +152,6 @@ def _run_fs_like(command: str, ns, opts) -> int:
     from trivy_tpu.artifact.local_fs import LocalFSArtifact
 
     target = ns.target
-    cache = _make_cache(opts)
     art_opt = _artifact_option(ns, opts)
 
     if command == "repo" and (
@@ -157,16 +161,20 @@ def _run_fs_like(command: str, ns, opts) -> int:
 
         target = checkout_repo(target)
 
-    artifact = LocalFSArtifact(target, cache, art_opt)
     server = opts.get("server")
     if server:
-        from trivy_tpu.rpc.client import RemoteDriver
+        # client mode: analysis is local, blobs ship to the SERVER's cache
+        # and detection runs there (ref: run.go:348-355 split)
+        from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
 
-        driver = RemoteDriver(server, token=opts.get("token"))
+        cache = RemoteCache(server, token=opts.get("token") or "")
+        driver = RemoteDriver(server, token=opts.get("token") or "")
     else:
         from trivy_tpu.scanner.local_driver import LocalDriver
 
+        cache = _make_cache(opts)
         driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    artifact = LocalFSArtifact(target, cache, art_opt)
     scanner = Scanner(artifact, driver)
     report = scanner.scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)
@@ -216,7 +224,14 @@ def _run_server(ns, opts) -> int:
     from trivy_tpu.rpc.server import serve
 
     host, _, port = ns.listen.rpartition(":")
-    serve(host or "0.0.0.0", int(port), cache_dir=opts.get("cache_dir"))
+    serve(
+        host or "0.0.0.0",
+        int(port),
+        cache_dir=opts.get("cache_dir"),
+        token=getattr(ns, "token", "") or "",
+        token_header=getattr(ns, "token_header", None) or "Trivy-Token",
+        db_repository=opts.get("db_repository"),
+    )
     return 0
 
 

@@ -118,11 +118,16 @@ def from_package(pkg, app_type: str = "", os_info=None) -> PackageURL | None:
         if pkg.epoch:
             qualifiers["epoch"] = str(pkg.epoch)
         qualifiers["distro"] = f"{family}-{os_info.name}"
+        # purl version carries the full distro version string incl. release
+        # (ref: purl.go utilVersion: "<version>-<release>", epoch qualifier)
+        version = pkg.version
+        if pkg.release:
+            version = f"{version}-{pkg.release}"
         return PackageURL(
             type=ptype,
             namespace=family,
             name=pkg.name,
-            version=pkg.version,
+            version=version,
             qualifiers=qualifiers,
         )
     ptype = APP_TO_PURL.get(app_type)

@@ -1,0 +1,20 @@
+"""Client/server RPC surface (ref: rpc/scanner/service.proto,
+rpc/cache/service.proto, pkg/rpc/server, pkg/rpc/client).
+
+The reference speaks Twirp (protobuf-over-HTTP POST). This build keeps the
+same service/route shape and split — client-side analysis pushing blobs via
+the Cache service, server-side vulnerability detection via Scanner.Scan —
+over JSON bodies (the wire format is private to this framework; the route
+names stay Twirp-style so operators see familiar paths in logs).
+"""
+
+SCANNER_SCAN = "/twirp/trivy.scanner.v1.Scanner/Scan"
+CACHE_PUT_ARTIFACT = "/twirp/trivy.cache.v1.Cache/PutArtifact"
+CACHE_PUT_BLOB = "/twirp/trivy.cache.v1.Cache/PutBlob"
+CACHE_MISSING_BLOBS = "/twirp/trivy.cache.v1.Cache/MissingBlobs"
+CACHE_DELETE_BLOBS = "/twirp/trivy.cache.v1.Cache/DeleteBlobs"
+HEALTHZ = "/healthz"
+VERSION = "/version"
+
+# ref: pkg/flag/server_flags.go default token header
+DEFAULT_TOKEN_HEADER = "Trivy-Token"

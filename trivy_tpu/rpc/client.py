@@ -1,0 +1,141 @@
+"""RPC client: remote cache + remote scan driver
+(ref: pkg/rpc/client/client.go, pkg/cache/remote.go, pkg/rpc/retry.go).
+
+The client analyzes locally, ships blobs to the server's cache, and asks the
+server to run detection. Requests retry with exponential backoff on
+connectivity errors and 5xx — the reference retries only on
+twirp.Unavailable (ref: retry.go:17-41); connection refused / 502 / 503 /
+504 map to the same class here.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from trivy_tpu import log, rpc
+from trivy_tpu.scanner import ScanOptions
+from trivy_tpu.types import OS, Result
+
+logger = log.logger("rpc:client")
+
+MAX_RETRIES = 10  # ref: retry.go retry count
+_RETRYABLE_HTTP = {502, 503, 504}
+
+
+class RPCError(Exception):
+    pass
+
+
+def _post(base: str, path: str, payload: dict, token: str, token_header: str,
+          timeout: float, retries: int = MAX_RETRIES) -> dict:
+    url = base.rstrip("/") + path
+    body = json.dumps(payload).encode()
+    backoff = 0.1
+    last: Exception | None = None
+    for attempt in range(retries + 1):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        if token:
+            req.add_header(token_header, token)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            if e.code in _RETRYABLE_HTTP and attempt < retries:
+                last = e
+            else:
+                try:
+                    detail = json.loads(e.read() or b"{}").get("error", "")
+                except Exception:
+                    detail = ""
+                raise RPCError(f"{path}: HTTP {e.code} {detail}".strip()) from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            if attempt >= retries:
+                raise RPCError(f"{path}: {e}") from e
+            last = e
+        logger.debug("retrying %s after %s (attempt %d)", path, last, attempt + 1)
+        time.sleep(backoff)
+        backoff = min(backoff * 2, 5.0)
+    raise RPCError(f"{path}: retries exhausted: {last}")
+
+
+class RemoteCache:
+    """Cache facade backed by the server's Cache service
+    (ref: pkg/cache/remote.go) — what client-side analysis writes to."""
+
+    def __init__(self, server: str, token: str = "",
+                 token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                 timeout: float = 30.0, retries: int = MAX_RETRIES):
+        self.base = server if "://" in server else f"http://{server}"
+        self.token = token
+        self.token_header = token_header
+        self.timeout = timeout
+        self.retries = retries
+
+    def _call(self, path: str, payload: dict) -> dict:
+        return _post(self.base, path, payload, self.token, self.token_header,
+                     self.timeout, self.retries)
+
+    def put_blob(self, blob_id: str, blob: dict) -> None:
+        self._call(rpc.CACHE_PUT_BLOB, {"DiffID": blob_id, "BlobInfo": blob})
+
+    def put_artifact(self, artifact_id: str, info: dict) -> None:
+        self._call(
+            rpc.CACHE_PUT_ARTIFACT,
+            {"ArtifactID": artifact_id, "ArtifactInfo": info},
+        )
+
+    def missing_blobs(self, artifact_id: str, blob_ids: list[str]):
+        resp = self._call(
+            rpc.CACHE_MISSING_BLOBS,
+            {"ArtifactID": artifact_id, "BlobIDs": blob_ids},
+        )
+        return bool(resp.get("MissingArtifact")), list(resp.get("MissingBlobIDs", []))
+
+    def delete_blobs(self, blob_ids: list[str]) -> None:
+        self._call(rpc.CACHE_DELETE_BLOBS, {"BlobIDs": blob_ids})
+
+    # local-read methods are not part of the remote surface
+    def get_blob(self, blob_id: str):
+        raise RPCError("RemoteCache has no local blob reads")
+
+
+class RemoteDriver:
+    """Scan driver that calls the server's Scanner service
+    (ref: pkg/rpc/client/client.go:69-100)."""
+
+    def __init__(self, server: str, token: str = "",
+                 token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                 timeout: float = 300.0, retries: int = MAX_RETRIES):
+        self.base = server if "://" in server else f"http://{server}"
+        self.token = token or ""
+        self.token_header = token_header
+        self.timeout = timeout
+        self.retries = retries
+
+    def scan(self, target: str, artifact_id: str, blob_ids: list[str],
+             options: ScanOptions):
+        resp = _post(
+            self.base,
+            rpc.SCANNER_SCAN,
+            {
+                "Target": target,
+                "ArtifactID": artifact_id,
+                "BlobIDs": blob_ids,
+                "Options": {
+                    "Scanners": list(options.scanners),
+                    "ListAllPkgs": options.list_all_pkgs,
+                },
+            },
+            self.token,
+            self.token_header,
+            self.timeout,
+            self.retries,
+        )
+        results = [Result.from_dict(r) for r in resp.get("Results", [])]
+        os_info = OS.from_dict(resp["OS"]) if resp.get("OS") else None
+        return results, os_info

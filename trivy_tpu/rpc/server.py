@@ -1,0 +1,174 @@
+"""Scan server (ref: pkg/rpc/server/listen.go, server.go).
+
+Serves the Cache and Scanner services over HTTP with optional token-header
+auth and /healthz + /version probes. Detection runs server-side against the
+server's cache + advisory DB; analysis stays client-side (ref:
+pkg/commands/artifact/run.go:348-355 split).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from trivy_tpu import log, rpc
+from trivy_tpu.scanner import ScanOptions
+
+logger = log.logger("rpc:server")
+
+
+class ScanServer:
+    """Service implementation bound to a cache and a local driver."""
+
+    def __init__(self, cache, vuln_client=None):
+        from trivy_tpu.scanner.local_driver import LocalDriver
+
+        self.cache = cache
+        self.driver = LocalDriver(cache, vuln_client=vuln_client)
+
+    # -- service methods (JSON dict in/out) ---------------------------------
+
+    def scan(self, req: dict) -> dict:
+        options = ScanOptions(
+            scanners=req.get("Options", {}).get("Scanners", ["vuln"]),
+            list_all_pkgs=bool(req.get("Options", {}).get("ListAllPkgs")),
+        )
+        results, os_info = self.driver.scan(
+            req.get("Target", ""),
+            req.get("ArtifactID", ""),
+            list(req.get("BlobIDs", [])),
+            options,
+        )
+        return {
+            "OS": os_info.to_dict() if os_info else None,
+            "Results": [r.to_dict() for r in results],
+        }
+
+    def put_blob(self, req: dict) -> dict:
+        self.cache.put_blob(req["DiffID"], req["BlobInfo"])
+        return {}
+
+    def put_artifact(self, req: dict) -> dict:
+        self.cache.put_artifact(req["ArtifactID"], req["ArtifactInfo"])
+        return {}
+
+    def missing_blobs(self, req: dict) -> dict:
+        missing_artifact, missing = self.cache.missing_blobs(
+            req.get("ArtifactID", ""), list(req.get("BlobIDs", []))
+        )
+        return {"MissingArtifact": missing_artifact, "MissingBlobIDs": missing}
+
+    def delete_blobs(self, req: dict) -> dict:
+        delete = getattr(self.cache, "delete_blobs", None)
+        if delete is not None:
+            delete(list(req.get("BlobIDs", [])))
+        return {}
+
+
+_ROUTES = {
+    rpc.SCANNER_SCAN: "scan",
+    rpc.CACHE_PUT_BLOB: "put_blob",
+    rpc.CACHE_PUT_ARTIFACT: "put_artifact",
+    rpc.CACHE_MISSING_BLOBS: "missing_blobs",
+    rpc.CACHE_DELETE_BLOBS: "delete_blobs",
+}
+
+
+def _make_handler(server: ScanServer, token: str, token_header: str):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through our logger
+            logger.debug("%s " + fmt, self.address_string(), *args)
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == rpc.HEALTHZ:
+                # plain "ok" like the reference's healthz
+                body = b"ok"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if self.path == rpc.VERSION:
+                from trivy_tpu import __version__
+
+                self._reply(200, {"Version": __version__})
+                return
+            self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            method = _ROUTES.get(self.path)
+            if method is None:
+                self._reply(404, {"error": f"no such route: {self.path}"})
+                return
+            if token and self.headers.get(token_header) != token:
+                self._reply(401, {"error": "invalid token"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                resp = getattr(server, method)(req)
+                self._reply(200, resp)
+            except KeyError as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+            except Exception as e:
+                logger.warning("rpc %s failed: %s", self.path, e)
+                self._reply(500, {"error": str(e)})
+
+    return Handler
+
+
+def start_server(
+    host: str = "127.0.0.1",
+    port: int = 0,
+    cache=None,
+    cache_dir: str | None = None,
+    vuln_client=None,
+    token: str = "",
+    token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+):
+    """Start the server on a background thread; returns (httpd, actual_port).
+    port=0 picks a free port — the reference's own client/server tests use
+    exactly this in-process technique (ref: integration/client_server_test.go)."""
+    if cache is None:
+        from trivy_tpu.cache import new_cache
+
+        cache = new_cache("fs", cache_dir)
+    service = ScanServer(cache, vuln_client=vuln_client)
+    httpd = ThreadingHTTPServer(
+        (host, port), _make_handler(service, token, token_header)
+    )
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd, httpd.server_address[1]
+
+
+def serve(host: str, port: int, cache_dir: str | None = None,
+          token: str = "", token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+          db_repository: str | None = None) -> None:
+    """Blocking server entrypoint for `trivy-tpu server`."""
+    from trivy_tpu.db import load_default_db
+
+    vuln_client = load_default_db(db_repository, cache_dir)
+    if vuln_client is None:
+        logger.warning("advisory DB not available; server scans skip vulns")
+    httpd, actual = start_server(
+        host, port, cache_dir=cache_dir, vuln_client=vuln_client,
+        token=token, token_header=token_header,
+    )
+    logger.info("listening on %s:%d", host, actual)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        httpd.shutdown()

@@ -39,8 +39,11 @@ class LocalDriver:
         detail = apply_layers(blobs)
         results: list[Result] = []
 
-        if "vuln" in options.scanners:
+        if "vuln" in options.scanners and self.vuln_client is not None:
             results.extend(self._scan_vulnerabilities(target, detail, options))
+        elif options.list_all_pkgs:
+            # package inventory without detection (SBOM output paths)
+            results.extend(self._package_results(target, detail))
         if "misconfig" in options.scanners:
             results.extend(self._misconfig_results(target, detail))
         if "secret" in options.scanners:
@@ -58,6 +61,31 @@ class LocalDriver:
         from trivy_tpu.detector import detect_all
 
         return detect_all(self.vuln_client, target, detail, options)
+
+    def _package_results(self, target, detail) -> list[Result]:
+        results: list[Result] = []
+        if detail.packages:
+            name = target
+            if detail.os:
+                name = f"{target} ({detail.os.family} {detail.os.name})"
+            results.append(
+                Result(
+                    target=name,
+                    cls=ResultClass.OS_PKGS.value,
+                    type=detail.os.family if detail.os else "",
+                    packages=detail.packages,
+                )
+            )
+        for app in sorted(detail.applications, key=lambda a: (a.file_path, a.type)):
+            results.append(
+                Result(
+                    target=app.file_path or app.type,
+                    cls=ResultClass.LANG_PKGS.value,
+                    type=app.type,
+                    packages=app.packages,
+                )
+            )
+        return results
 
     def _secret_results(self, detail) -> list[Result]:
         out = []
