@@ -38,6 +38,13 @@ def test_detect_cloudformation():
     assert detection.detect_type("main.tf", b"") == "terraform"
 
 
+def test_detect_non_dict_resources_does_not_raise():
+    # regression: 'Resources: [a, b]' used to evaluate .values() before the
+    # isinstance guard and raise AttributeError, killing the CONFIG batch
+    assert detection.detect_type("x.yaml", b"Resources: [a, b]\n") == "yaml"
+    assert detection.detect_type("x.json", b'{"Resources": [1, 2]}') == "json"
+
+
 # -- dockerfile parser -------------------------------------------------------
 
 def test_dockerfile_parse_continuations_and_stages():

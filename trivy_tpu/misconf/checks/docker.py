@@ -314,7 +314,7 @@ def _pkg_mgr_missing_clean(df, mgr: str, clean_words: tuple, message: str):
             continue
         cleaned = any(
             c[0] == mgr and any(w in c for w in clean_words) for c in cmds
-        ) or any("rm" in c[0] for c in cmds)
+        ) or any(c and c[0] == "rm" for c in cmds)
         if not cleaned:
             yield Failure(
                 message=message, start_line=i.start_line, end_line=i.end_line

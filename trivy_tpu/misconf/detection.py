@@ -100,6 +100,18 @@ def is_kubernetes(path: str, content: bytes) -> bool:
     return False
 
 
+def _looks_cloudformation(d) -> bool:
+    if not isinstance(d, dict):
+        return False
+    res = d.get("Resources")
+    if not isinstance(res, dict):
+        return False
+    return "AWSTemplateFormatVersion" in d or any(
+        isinstance(r, dict) and str(r.get("Type", "")).startswith("AWS::")
+        for r in res.values()
+    )
+
+
 def is_cloudformation(path: str, content: bytes) -> bool:
     """Template with a Resources top-level section (ref: detect.go:110-135
     sniffs for the Resources key in yaml/json)."""
@@ -107,28 +119,13 @@ def is_cloudformation(path: str, content: bytes) -> bool:
         docs = _load_yaml_docs(content)
         if not docs:
             return False
-        d = docs[0]
-        return isinstance(d, dict) and "Resources" in d and (
-            "AWSTemplateFormatVersion" in d
-            or any(
-                isinstance(r, dict) and str(r.get("Type", "")).startswith("AWS::")
-                for r in d["Resources"].values()
-                if isinstance(d["Resources"], dict)
-            )
-        )
+        return _looks_cloudformation(docs[0])
     if path.endswith(".json"):
         try:
             d = json.loads(content)
         except Exception:
             return False
-        return isinstance(d, dict) and "Resources" in d and (
-            "AWSTemplateFormatVersion" in d
-            or any(
-                isinstance(r, dict) and str(r.get("Type", "")).startswith("AWS::")
-                for r in d["Resources"].values()
-                if isinstance(d["Resources"], dict)
-            )
-        )
+        return _looks_cloudformation(d)
     return False
 
 

@@ -44,7 +44,11 @@ class MisconfScanner:
         self._disabled = set(self.option.check_ids_disabled)
 
     def scan_file(self, path: str, content: bytes) -> Misconfiguration | None:
-        ftype = detection.detect_type(path, content)
+        try:
+            ftype = detection.detect_type(path, content)
+        except Exception as e:  # one undetectable file must not kill the batch
+            logger.debug("misconf type detection failed for %s: %s", path, e)
+            return None
         if ftype is None:
             return None
         try:

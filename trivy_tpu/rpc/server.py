@@ -8,6 +8,7 @@ pkg/commands/artifact/run.go:348-355 split).
 
 from __future__ import annotations
 
+import hmac
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -16,6 +17,10 @@ from trivy_tpu import log, rpc
 from trivy_tpu.scanner import ScanOptions
 
 logger = log.logger("rpc:server")
+
+# request-body ceiling; blobs are analysis metadata, not file contents, so
+# 256 MiB is generous headroom while bounding a hostile Content-Length
+MAX_REQUEST_BYTES = 256 * 1024 * 1024
 
 
 class ScanServer:
@@ -112,11 +117,17 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             if method is None:
                 self._reply(404, {"error": f"no such route: {self.path}"})
                 return
-            if token and self.headers.get(token_header) != token:
+            if token and not hmac.compare_digest(
+                self.headers.get(token_header, "").encode("latin-1", "replace"),
+                token.encode("latin-1", "replace"),
+            ):
                 self._reply(401, {"error": "invalid token"})
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
+                if length < 0 or length > MAX_REQUEST_BYTES:
+                    self._reply(413, {"error": "request too large"})
+                    return
                 req = json.loads(self.rfile.read(length) or b"{}")
                 resp = getattr(server, method)(req)
                 self._reply(200, resp)

@@ -236,10 +236,14 @@ class SecretScanner:
         if not rule.match_keywords(lower):  # keywords are a whole-file test
             return []
         wmax = rule.max_match_width
-        if wmax is None or wmax > 8192:
+        if wmax is None or wmax > 8192 or rule.has_lookaround:
+            # lookarounds examine context beyond getwidth()'s bound, so the
+            # fixed padding below cannot guarantee parity — full scan instead
             return self.find_rule_locations(rule, content, lower, global_blocks)
         n = len(content)
-        pad = wmax + 256  # slack for short lookarounds beyond the match
+        # slack beyond the match width for anchor/word-prefix context; rules
+        # with lookarounds never reach this path (full-scan fallback above)
+        pad = wmax + 256
         ivs = sorted((max(0, s - pad), min(n, e + pad)) for s, e in windows)
         merged: list[list[int]] = []
         for s, e in ivs:

@@ -101,6 +101,36 @@ class Rule:
             return None
 
     @cached_property
+    def has_lookaround(self) -> bool:
+        """True when the pattern contains lookahead/lookbehind assertions.
+        Lookarounds contribute zero to getwidth(), so window-restricted
+        scanning cannot bound the context they examine — such rules must take
+        the full-content scan path to stay parity-identical."""
+        try:
+            import re._parser as sre_parse
+
+            def walk(items) -> bool:
+                for op, av in items:
+                    name = str(op)
+                    if name in ("ASSERT", "ASSERT_NOT"):
+                        return True
+                    if isinstance(av, tuple):
+                        for part in av:
+                            if isinstance(part, sre_parse.SubPattern) and walk(part):
+                                return True
+                            if isinstance(part, (list, tuple)):
+                                for sub in part:
+                                    if isinstance(sub, sre_parse.SubPattern) and walk(sub):
+                                        return True
+                    elif isinstance(av, sre_parse.SubPattern) and walk(av):
+                        return True
+                return False
+
+            return walk(sre_parse.parse(self.regex))
+        except Exception:
+            return True
+
+    @cached_property
     def lower_keywords(self) -> list[str]:
         return [k.lower() for k in self.keywords]
 
