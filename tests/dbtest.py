@@ -19,6 +19,18 @@ ADVISORIES = {
             {"VulnerabilityID": "CVE-2023-1111", "FixedVersion": "3.0.11-1~deb12u1"},
         ],
     },
+    # rpm family: centos buckets under "redhat <major>"
+    "redhat 9": {
+        "bash": [
+            {"VulnerabilityID": "CVE-2024-0001", "FixedVersion": "5.1.8-7.el9"},
+        ],
+        "openssl": [
+            {"VulnerabilityID": "CVE-2024-0002", "FixedVersion": "1:3.0.7-25.el9"},
+        ],
+        "nodejs:16::nodejs": [
+            {"VulnerabilityID": "CVE-2024-0003", "FixedVersion": "1:16.20.2-3.el9"},
+        ],
+    },
     # rolling distro: bucket has no version component
     "wolfi": {
         "git": [
@@ -68,6 +80,9 @@ DETAILS = {
     },
     "CVE-2020-7598": {"Title": "minimist prototype pollution", "Severity": "MEDIUM"},
     "CVE-2023-2222": {"Title": "django bug", "Severity": "HIGH"},
+    "CVE-2024-0001": {"Title": "bash: code exec", "Severity": "HIGH"},
+    "CVE-2024-0002": {"Title": "openssl: dos", "Severity": "MEDIUM"},
+    "CVE-2024-0003": {"Title": "nodejs module bug", "Severity": "HIGH"},
 }
 
 

@@ -1,0 +1,200 @@
+"""RPM database parsing, analyzer, and RedHat-family e2e detection."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.dbtest import build_db
+from trivy_tpu.fanal import rpmdb
+from trivy_tpu.fanal.analyzer import AnalysisInput
+from trivy_tpu.fanal.analyzers.pkg_rpm import RpmAnalyzer, split_source_rpm
+from trivy_tpu.fanal.walker import FileInfo
+
+
+def _bash_header() -> bytes:
+    return rpmdb.encode_header_blob({
+        rpmdb.TAG_NAME: "bash",
+        rpmdb.TAG_VERSION: "5.1.8",
+        rpmdb.TAG_RELEASE: "6.el9",
+        rpmdb.TAG_ARCH: "x86_64",
+        rpmdb.TAG_VENDOR: "Red Hat, Inc.",
+        rpmdb.TAG_LICENSE: "GPLv3+",
+        rpmdb.TAG_SOURCERPM: "bash-5.1.8-6.el9.src.rpm",
+        rpmdb.TAG_SIGMD5: bytes.fromhex("d41d8cd98f00b204e9800998ecf8427e"),
+        rpmdb.TAG_DIRNAMES: ["/usr/bin/", "/etc/"],
+        rpmdb.TAG_BASENAMES: ["bash", "bashrc"],
+        rpmdb.TAG_DIRINDEXES: [0, 1],
+        rpmdb.TAG_PROVIDENAME: ["bash", "/bin/sh"],
+        rpmdb.TAG_REQUIRENAME: ["libtinfo.so.6()(64bit)"],
+    })
+
+
+def _openssl_header() -> bytes:
+    return rpmdb.encode_header_blob({
+        rpmdb.TAG_NAME: "openssl",
+        rpmdb.TAG_VERSION: "3.0.7",
+        rpmdb.TAG_RELEASE: "24.el9",
+        rpmdb.TAG_EPOCH: 1,
+        rpmdb.TAG_ARCH: "x86_64",
+        rpmdb.TAG_VENDOR: "Red Hat, Inc.",
+        rpmdb.TAG_LICENSE: "ASL 2.0",
+        rpmdb.TAG_SOURCERPM: "openssl-3.0.7-24.el9.src.rpm",
+        rpmdb.TAG_PROVIDENAME: ["openssl", "libtinfo.so.6()(64bit)"],
+        rpmdb.TAG_REQUIRENAME: ["/bin/sh"],
+    })
+
+
+def _nodejs_header() -> bytes:
+    return rpmdb.encode_header_blob({
+        rpmdb.TAG_NAME: "nodejs",
+        rpmdb.TAG_VERSION: "16.20.2",
+        rpmdb.TAG_RELEASE: "2.el9",
+        rpmdb.TAG_EPOCH: 1,
+        rpmdb.TAG_ARCH: "x86_64",
+        rpmdb.TAG_VENDOR: "Red Hat, Inc.",
+        rpmdb.TAG_MODULARITYLABEL: "nodejs:16:9030:20230718",
+        rpmdb.TAG_SOURCERPM: "nodejs-16.20.2-2.el9.src.rpm",
+    })
+
+
+def _third_party_header() -> bytes:
+    # not vendor-provided: installed files must NOT be collected
+    return rpmdb.encode_header_blob({
+        rpmdb.TAG_NAME: "mytool",
+        rpmdb.TAG_VERSION: "1.0",
+        rpmdb.TAG_RELEASE: "1",
+        rpmdb.TAG_ARCH: "noarch",
+        rpmdb.TAG_VENDOR: "ACME Corp",
+        rpmdb.TAG_SOURCERPM: "(none)",
+        rpmdb.TAG_DIRNAMES: ["/opt/mytool/"],
+        rpmdb.TAG_BASENAMES: ["tool.py"],
+        rpmdb.TAG_DIRINDEXES: [0],
+    })
+
+
+ALL = [_bash_header, _openssl_header, _nodejs_header, _third_party_header]
+
+
+def test_split_source_rpm():
+    assert split_source_rpm("bash-5.1.8-6.el9.src.rpm") == ("bash", "5.1.8", "6.el9")
+    assert split_source_rpm("gcc-c++-11.3.1-4.3.el9.src.rpm") == (
+        "gcc-c++", "11.3.1", "4.3.el9",
+    )
+    with pytest.raises(ValueError):
+        split_source_rpm("garbage")
+
+
+def test_header_blob_roundtrip():
+    h = rpmdb.parse_header_blob(_bash_header())
+    assert h.str_(rpmdb.TAG_NAME) == "bash"
+    assert h.str_(rpmdb.TAG_VERSION) == "5.1.8"
+    assert h.list_(rpmdb.TAG_BASENAMES) == ["bash", "bashrc"]
+    assert h.list_(rpmdb.TAG_DIRINDEXES) == [0, 1]
+    assert h.int_(rpmdb.TAG_EPOCH) == 0
+    h2 = rpmdb.parse_header_blob(_openssl_header())
+    assert h2.int_(rpmdb.TAG_EPOCH) == 1
+
+
+@pytest.mark.parametrize("container", ["sqlite", "ndb"])
+def test_container_roundtrip(container):
+    blobs = [f() for f in ALL]
+    content = (
+        rpmdb.build_sqlite_db(blobs) if container == "sqlite" else rpmdb.build_ndb(blobs)
+    )
+    assert rpmdb.detect_format(content) == container
+    headers = rpmdb.read_headers(content)
+    assert [h.str_(rpmdb.TAG_NAME) for h in headers] == [
+        "bash", "openssl", "nodejs", "mytool",
+    ]
+
+
+def _run(path: str, content: bytes):
+    a = RpmAnalyzer(None)
+    info = FileInfo(size=len(content), mode=0o644)
+    assert a.required(path, info)
+    return a.analyze(AnalysisInput(dir="/x", file_path=path, info=info, content=content))
+
+
+def test_rpm_analyzer_sqlite():
+    content = rpmdb.build_sqlite_db([f() for f in ALL])
+    r = _run("var/lib/rpm/rpmdb.sqlite", content)
+    pkgs = {p.name: p for p in r.package_infos[0].packages}
+    bash = pkgs["bash"]
+    assert bash.version == "5.1.8" and bash.release == "6.el9" and bash.epoch == 0
+    assert bash.src_name == "bash" and bash.src_version == "5.1.8"
+    assert bash.id == "bash@5.1.8-6.el9.x86_64"
+    assert bash.licenses == ["GPLv3+"]
+    assert bash.maintainer == "Red Hat, Inc."
+    assert bash.digest == "md5:d41d8cd98f00b204e9800998ecf8427e"
+    # bash requires libtinfo which openssl provides in this fixture
+    assert bash.depends_on == ["openssl@3.0.7-24.el9.x86_64"]
+    # openssl requires /bin/sh provided by bash
+    assert pkgs["openssl"].depends_on == ["bash@5.1.8-6.el9.x86_64"]
+    assert pkgs["openssl"].epoch == 1 and pkgs["openssl"].src_epoch == 1
+    assert pkgs["nodejs"].modularitylabel == "nodejs:16:9030:20230718"
+    # vendor files collected; third-party files not
+    assert "usr/bin/bash" in r.system_files
+    assert all("mytool" not in f for f in r.system_files)
+
+
+def test_rpm_analyzer_ndb_paths():
+    content = rpmdb.build_ndb([_bash_header()])
+    r = _run("usr/lib/sysimage/rpm/Packages.db", content)
+    assert r.package_infos[0].packages[0].name == "bash"
+
+
+def test_bdb_unsupported_is_graceful():
+    # BerkeleyDB hash magic at offset 12
+    content = b"\0" * 12 + (0x00061561).to_bytes(4, "little") + b"\0" * 64
+    a = RpmAnalyzer(None)
+    info = FileInfo(size=len(content), mode=0o644)
+    assert a.analyze(
+        AnalysisInput(dir="/x", file_path="var/lib/rpm/Packages", info=info, content=content)
+    ) is None
+
+
+def test_modular_advisory_lookup(tmp_path):
+    from trivy_tpu.db import VulnDB
+    from trivy_tpu.detector import ospkg
+    from trivy_tpu.types import OS, Package
+
+    db = VulnDB.load(build_db(tmp_path))
+    pkgs = [
+        Package(name="nodejs", version="16.20.2", release="2.el9", epoch=1,
+                modularitylabel="nodejs:16:9030:20230718"),
+        # same package without the module label must NOT match
+        Package(name="nodejs", version="16.20.2", release="2.el9", epoch=1),
+    ]
+    vulns = ospkg.detect(db, OS(family="centos", name="9.2"), pkgs)
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2024-0003"]
+
+
+def test_centos_rootfs_e2e(tmp_path):
+    root = tmp_path / "rootfs"
+    (root / "etc").mkdir(parents=True)
+    (root / "var/lib/rpm").mkdir(parents=True)
+    (root / "etc/os-release").write_text(
+        'NAME="CentOS Stream"\nID="centos"\nID_LIKE="rhel fedora"\nVERSION_ID="9"\n'
+    )
+    (root / "var/lib/rpm/rpmdb.sqlite").write_bytes(
+        rpmdb.build_sqlite_db([_bash_header(), _openssl_header()])
+    )
+    db_dir = build_db(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", "rootfs", "--scanners", "vuln",
+         "--format", "json", "--cache-dir", str(tmp_path / "cache"),
+         "--db-repository", db_dir, str(root)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    assert doc["Metadata"]["OS"]["Family"] == "centos"
+    res = [r for r in doc["Results"] if r.get("Vulnerabilities")]
+    assert len(res) == 1
+    ids = {v["VulnerabilityID"] for v in res[0]["Vulnerabilities"]}
+    # bash 5.1.8-6.el9 < 5.1.8-7.el9 and openssl 1:3.0.7-24.el9 < 1:3.0.7-25.el9
+    assert ids == {"CVE-2024-0001", "CVE-2024-0002"}

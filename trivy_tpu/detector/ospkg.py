@@ -107,6 +107,13 @@ def detect(db, os_info: OS, packages: list[Package]) -> list[DetectedVulnerabili
         names = [pkg.name]
         if pkg.src_name and pkg.src_name != pkg.name:
             names.append(pkg.src_name)
+        if driver.scheme == "rpm" and pkg.modularitylabel:
+            # modular packages are advisory-keyed by "name:stream::pkg"
+            # (ref: pkg/detector/ospkg/redhat/redhat.go module handling)
+            parts = pkg.modularitylabel.split(":")
+            if len(parts) >= 2:
+                module = ":".join(parts[:2])
+                names = [f"{module}::{n}" for n in names]
         installed = _installed_version(pkg, driver.scheme)
         seen: set[str] = set()
         for name in names:

@@ -7,5 +7,6 @@ from trivy_tpu.fanal.analyzers import (  # noqa: F401
     os_release,
     pkg_apk,
     pkg_dpkg,
+    pkg_rpm,
     secret,
 )

@@ -234,6 +234,9 @@ class Package:
     identifier: PkgIdentifier = field(default_factory=PkgIdentifier)
     layer: str = ""
     locations: list[dict[str, int]] = field(default_factory=list)  # [{"StartLine":..,"EndLine":..}]
+    maintainer: str = ""  # vendor for rpm packages
+    modularitylabel: str = ""  # RedHat module stream, e.g. nodejs:10:...
+    digest: str = ""  # e.g. md5:<sigmd5> for rpm
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -256,6 +259,9 @@ class Package:
             "Identifier": self.identifier.to_dict(),
             "Layer": self.layer,
             "Locations": list(self.locations),
+            "Maintainer": self.maintainer,
+            "Modularitylabel": self.modularitylabel,
+            "Digest": self.digest,
         }
 
     @classmethod
@@ -280,6 +286,9 @@ class Package:
             identifier=PkgIdentifier.from_dict(d.get("Identifier", {}) or {}),
             layer=d.get("Layer", ""),
             locations=list(d.get("Locations", []) or []),
+            maintainer=d.get("Maintainer", ""),
+            modularitylabel=d.get("Modularitylabel", ""),
+            digest=d.get("Digest", ""),
         )
 
 
