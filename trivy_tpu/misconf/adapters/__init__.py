@@ -1,0 +1,2 @@
+"""IaC adapters: evaluated config blocks → typed provider state
+(ref: pkg/iac/adapters — independent, deliberately leaner implementation)."""

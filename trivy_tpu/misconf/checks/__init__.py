@@ -44,6 +44,7 @@ class Check:
     url: str = ""
     service: str = "general"
     provider: str = ""
+    targets: str = ""  # cloud checks: state collection they inspect
 
     @property
     def namespace(self) -> str:
@@ -51,7 +52,17 @@ class Check:
         return f"builtin.{self.provider or self.file_types[0]}.{self.id}"
 
 
+@dataclass
+class CloudFailure:
+    """A cloud-check failure anchored to a tracked value (file + lines)."""
+
+    message: str
+    val: object = None  # state.Val cause; None -> resource anchor
+    resource: str = ""
+
+
 _registry: dict[str, Check] = {}
+_cloud_registry: dict[str, Check] = {}
 
 
 def register(check: Check) -> Check:
@@ -59,6 +70,19 @@ def register(check: Check) -> Check:
         raise ValueError(f"check {check.id} registered twice")
     _registry[check.id] = check
     return check
+
+
+def register_cloud(check: Check) -> Check:
+    """Register a check over typed provider state (terraform + CFN)."""
+    if check.id in _cloud_registry:
+        raise ValueError(f"cloud check {check.id} registered twice")
+    _cloud_registry[check.id] = check
+    return check
+
+
+def cloud_checks() -> list[Check]:
+    _load_builtins()
+    return sorted(_cloud_registry.values(), key=lambda c: c.id)
 
 
 def checks_for(file_type: str) -> list[Check]:
@@ -81,6 +105,7 @@ def _load_builtins() -> None:
     global _loaded
     if not _loaded:
         _loaded = True
+        import trivy_tpu.misconf.checks.cloud_aws  # noqa: F401
         import trivy_tpu.misconf.checks.docker  # noqa: F401
         import trivy_tpu.misconf.checks.kubernetes  # noqa: F401
 
@@ -131,3 +156,73 @@ def evaluate(
     mc.successes.sort(key=lambda r: r.id)
     mc.failures.sort(key=lambda r: (r.id, r.start_line, r.message))
     return mc
+
+
+def _result_base(check: Check, scanner_name: str) -> dict:
+    return dict(
+        id=check.id,
+        avd_id=check.avd_id,
+        type=f"{scanner_name} Security Check",
+        title=check.title,
+        description=check.description,
+        namespace=check.namespace,
+        query=f"data.{check.namespace}.deny",
+        resolution=check.resolution,
+        severity=check.severity,
+        primary_url=check.url,
+        references=[check.url] if check.url else [],
+        provider=check.provider,
+        service=check.service,
+    )
+
+
+def evaluate_cloud(
+    state,
+    files: list[str],
+    file_type: str,
+    scanner_name: str,
+    enabled: Callable[[Check], bool] = lambda c: True,
+) -> dict[str, Misconfiguration]:
+    """Run cloud checks over typed provider state; group results per file.
+
+    A check with no failure in a given scanned file is a PASS for that file
+    (per-file status, matching the reference's per-input successes).
+    """
+    out: dict[str, Misconfiguration] = {
+        f: Misconfiguration(file_type=file_type, file_path=f) for f in files
+    }
+    for check in cloud_checks():
+        if not enabled(check):
+            continue
+        if check.targets and not getattr(state, check.targets, None):
+            continue  # no matching resources: check not evaluated (no PASS noise)
+        failures = list(check.fn(state))
+        base = _result_base(check, scanner_name)
+        failed_files: set[str] = set()
+        for f in failures:
+            val = f.val
+            file = getattr(val, "file", "") or ""
+            if file not in out:
+                # cause in an unscanned file (e.g. module dir outside input):
+                # attribute to the first scanned file as a fallback
+                file = files[0] if files else ""
+                if file not in out:
+                    continue
+            failed_files.add(file)
+            out[file].failures.append(
+                MisconfResult(
+                    status="FAIL",
+                    message=f.message,
+                    start_line=getattr(val, "line", 0) or 0,
+                    end_line=getattr(val, "end_line", 0) or 0,
+                    resource=f.resource,
+                    **base,
+                )
+            )
+        for file, mc in out.items():
+            if file not in failed_files:
+                mc.successes.append(MisconfResult(status="PASS", **base))
+    for mc in out.values():
+        mc.successes.sort(key=lambda r: r.id)
+        mc.failures.sort(key=lambda r: (r.id, r.start_line, r.message))
+    return out

@@ -1,0 +1,832 @@
+"""Builtin AWS cloud checks over typed provider state.
+
+Independently-authored equivalents of the reference's embedded AWS check
+bundle (AVD-AWS IDs are the public reporting/suppression interface; the
+check logic here is written against this repo's own state model). Each
+check yields :class:`CloudFailure` records whose tracked values carry the
+file + line causes, so one check serves terraform and CloudFormation.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.misconf.adapters.aws_state import AWSState
+from trivy_tpu.misconf.checks import Check, CloudFailure, register_cloud
+
+_TYPES = ("terraform", "cloudformation")
+_URL = "https://avd.aquasec.com/misconfig/{}"
+
+
+# which state collection a check inspects (used to skip checks with no
+# matching resources); services with several collections pass targets=...
+_SERVICE_TARGETS = {
+    "s3": "s3_buckets", "rds": "rds_instances", "cloudtrail": "cloudtrails",
+    "eks": "eks_clusters", "kms": "kms_keys", "sns": "sns_topics",
+    "sqs": "sqs_queues", "ecr": "ecr_repositories", "efs": "efs_filesystems",
+    "elasticache": "elasticache_groups", "redshift": "redshift_clusters",
+    "dynamodb": "dynamodb_tables", "cloudfront": "cloudfront_distributions",
+    "lambda": "lambda_functions",
+}
+
+
+def _check(id_, title, severity, service, desc="", res="", targets=None):
+    if targets is None:
+        targets = _SERVICE_TARGETS.get(service, "")
+
+    def wrap(fn):
+        register_cloud(
+            Check(
+                id=id_,
+                avd_id=id_,
+                title=title,
+                severity=severity,
+                file_types=_TYPES,
+                fn=fn,
+                description=desc,
+                resolution=res,
+                url=_URL.format(id_.lower()),
+                service=service,
+                provider="aws",
+                targets=targets,
+            )
+        )
+        return fn
+
+    return wrap
+
+
+_PUBLIC_CIDRS = ("0.0.0.0/0", "::/0")
+
+
+def _is_public_cidr(c: str) -> bool:
+    if c in _PUBLIC_CIDRS:
+        return True
+    if c.endswith("/0"):
+        return True
+    return False
+
+
+# -- S3 -----------------------------------------------------------------------
+
+@_check("AVD-AWS-0086", "S3 Access block should block public ACLs", "HIGH", "s3",
+        "PUT calls with public ACLs should be blocked.",
+        "Set block_public_acls on the bucket's public access block.")
+def s3_block_public_acls(st: AWSState):
+    for b in st.s3_buckets:
+        pab = b.public_access_block
+        if pab is None:
+            continue  # AVD-AWS-0094 reports the missing block
+        if not pab.block_public_acls.bool():
+            yield CloudFailure(
+                "No public access block so not blocking public acls",
+                pab.block_public_acls if pab.block_public_acls.explicit else pab.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0087", "S3 Access block should block public policy", "HIGH", "s3",
+        "Bucket policies granting public access should be blocked.",
+        "Set block_public_policy on the bucket's public access block.")
+def s3_block_public_policy(st: AWSState):
+    for b in st.s3_buckets:
+        pab = b.public_access_block
+        if pab is None:
+            continue
+        if not pab.block_public_policy.bool():
+            yield CloudFailure(
+                "No public access block so not blocking public policies",
+                pab.block_public_policy if pab.block_public_policy.explicit else pab.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0091", "S3 Access Block should ignore public ACLs", "HIGH", "s3",
+        "Existing public ACLs should be ignored.",
+        "Set ignore_public_acls on the bucket's public access block.")
+def s3_ignore_public_acls(st: AWSState):
+    for b in st.s3_buckets:
+        pab = b.public_access_block
+        if pab is None:
+            continue
+        if not pab.ignore_public_acls.bool():
+            yield CloudFailure(
+                "No public access block so not ignoring public acls",
+                pab.ignore_public_acls if pab.ignore_public_acls.explicit else pab.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0093", "S3 Access block should restrict public buckets", "HIGH", "s3",
+        "Public bucket policies should be restricted to AWS service principals.",
+        "Set restrict_public_buckets on the bucket's public access block.")
+def s3_restrict_public_buckets(st: AWSState):
+    for b in st.s3_buckets:
+        pab = b.public_access_block
+        if pab is None:
+            continue
+        if not pab.restrict_public_buckets.bool():
+            yield CloudFailure(
+                "No public access block so not restricting public buckets",
+                pab.restrict_public_buckets if pab.restrict_public_buckets.explicit else pab.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0094", "S3 buckets should each define a Public Access Block", "LOW", "s3",
+        "Without a public access block, misconfigured policies/ACLs expose the bucket.",
+        "Define an aws_s3_bucket_public_access_block for the bucket.")
+def s3_missing_public_access_block(st: AWSState):
+    for b in st.s3_buckets:
+        if b.public_access_block is None:
+            yield CloudFailure(
+                "Bucket does not have a corresponding public access block.",
+                b.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0092", "S3 Buckets not publicly accessible through ACL", "HIGH", "s3",
+        "Public ACLs expose bucket contents to the internet.",
+        "Use a private ACL.")
+def s3_public_acl(st: AWSState):
+    for b in st.s3_buckets:
+        acl = b.acl.str()
+        if acl in ("public-read", "public-read-write", "website", "authenticated-read"):
+            yield CloudFailure(
+                f"Bucket has a public ACL: {acl!r}.", b.acl, b.address
+            )
+
+
+@_check("AVD-AWS-0088", "Unencrypted S3 bucket", "HIGH", "s3",
+        "Server-side encryption protects bucket contents at rest.",
+        "Configure bucket encryption.")
+def s3_encryption(st: AWSState):
+    for b in st.s3_buckets:
+        if not b.encryption_enabled.bool():
+            yield CloudFailure(
+                "Bucket does not have encryption enabled",
+                b.encryption_enabled if b.encryption_enabled.explicit else b.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0090", "S3 Data should be versioned", "MEDIUM", "s3",
+        "Versioning protects against accidental or malicious overwrite/delete.",
+        "Enable versioning.")
+def s3_versioning(st: AWSState):
+    for b in st.s3_buckets:
+        if not b.versioning_enabled.bool():
+            yield CloudFailure(
+                "Bucket does not have versioning enabled",
+                b.versioning_enabled if b.versioning_enabled.explicit else b.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0089", "S3 Bucket Logging", "LOW", "s3",
+        "Access logging provides an audit trail of requests.",
+        "Add a logging block / LoggingConfiguration.")
+def s3_logging(st: AWSState):
+    for b in st.s3_buckets:
+        if not b.logging_enabled.bool() and b.acl.str() != "log-delivery-write":
+            yield CloudFailure(
+                "Bucket does not have logging enabled",
+                b.logging_enabled if b.logging_enabled.explicit else b.anchor(),
+                b.address,
+            )
+
+
+# -- EC2 / VPC ---------------------------------------------------------------
+
+@_check("AVD-AWS-0107", "An ingress security group rule allows traffic from /0", "CRITICAL", "ec2",
+        "Opening ports to the entire internet maximizes attack surface.",
+        "Restrict ingress CIDR ranges.", targets="security_groups")
+def sg_public_ingress(st: AWSState):
+    for sg in st.security_groups:
+        for r in sg.rules:
+            if r.type != "ingress":
+                continue
+            for c in r.cidrs.list() or ([r.cidrs.str()] if r.cidrs.is_set() and r.cidrs.str() else []):
+                if isinstance(c, str) and _is_public_cidr(c):
+                    yield CloudFailure(
+                        f"Security group rule allows ingress from public internet ({c}).",
+                        r.cidrs if r.cidrs.explicit else r.anchor(),
+                        sg.address,
+                    )
+                    break
+
+
+@_check("AVD-AWS-0104", "An egress security group rule allows traffic to /0", "CRITICAL", "ec2",
+        "Unrestricted egress eases data exfiltration after compromise.",
+        "Restrict egress CIDR ranges.", targets="security_groups")
+def sg_public_egress(st: AWSState):
+    for sg in st.security_groups:
+        for r in sg.rules:
+            if r.type != "egress":
+                continue
+            for c in r.cidrs.list() or ([r.cidrs.str()] if r.cidrs.is_set() and r.cidrs.str() else []):
+                if isinstance(c, str) and _is_public_cidr(c):
+                    yield CloudFailure(
+                        f"Security group rule allows egress to multiple public internet addresses ({c}).",
+                        r.cidrs if r.cidrs.explicit else r.anchor(),
+                        sg.address,
+                    )
+                    break
+
+
+@_check("AVD-AWS-0124", "Missing description for security group rule", "LOW", "ec2",
+        "Descriptions document intent and ease audits.",
+        "Add a description to every security group rule.", targets="security_groups")
+def sg_rule_description(st: AWSState):
+    for sg in st.security_groups:
+        for r in sg.rules:
+            if not r.description.str():
+                yield CloudFailure(
+                    "Security group rule does not have a description.",
+                    r.anchor(),
+                    sg.address,
+                )
+
+
+@_check("AVD-AWS-0028", "aws_instance should activate session tokens for Instance Metadata Service", "HIGH", "ec2",
+        "IMDSv1 is vulnerable to SSRF; require session tokens (IMDSv2).",
+        "Set metadata_options http_tokens = \"required\".", targets="instances")
+def ec2_imdsv2(st: AWSState):
+    for i in st.instances:
+        if i.http_endpoint.str() == "disabled":
+            continue
+        if i.http_tokens.str() != "required":
+            yield CloudFailure(
+                "Instance does not require IMDS access to require a token",
+                i.http_tokens if i.http_tokens.explicit else i.anchor(),
+                i.address,
+            )
+
+
+@_check("AVD-AWS-0131", "Instances with unencrypted block devices", "HIGH", "ec2",
+        "Root and EBS block devices should be encrypted at rest.",
+        "Set encrypted = true on block devices.", targets="instances")
+def ec2_encrypted_devices(st: AWSState):
+    for i in st.instances:
+        devices = ([i.root_device] if i.root_device is not None else []) + i.ebs_devices
+        for d in devices:
+            if not d.encrypted.bool():
+                yield CloudFailure(
+                    "Instance has an unencrypted block device.",
+                    d.encrypted if d.encrypted.explicit else d.anchor(),
+                    i.address,
+                )
+
+
+@_check("AVD-AWS-0026", "Enable EBS volume encryption", "HIGH", "ec2",
+        "Unencrypted EBS volumes expose data at rest.",
+        "Set encrypted = true on the volume.", targets="volumes")
+def ebs_volume_encrypted(st: AWSState):
+    for v in st.volumes:
+        if not v.encrypted.bool():
+            yield CloudFailure(
+                "EBS volume is not encrypted.",
+                v.encrypted if v.encrypted.explicit else v.anchor(),
+                v.address,
+            )
+
+
+# -- RDS ---------------------------------------------------------------------
+
+@_check("AVD-AWS-0080", "RDS Encryption", "HIGH", "rds",
+        "Unencrypted RDS storage exposes data at rest.",
+        "Set storage_encrypted = true.")
+def rds_encrypted(st: AWSState):
+    for db in st.rds_instances:
+        if not db.storage_encrypted.bool():
+            yield CloudFailure(
+                "Instance does not have storage encryption enabled.",
+                db.storage_encrypted if db.storage_encrypted.explicit else db.anchor(),
+                db.address,
+            )
+
+
+@_check("AVD-AWS-0180", "RDS Publicly Accessible", "CRITICAL", "rds",
+        "Publicly accessible databases are exposed to the internet.",
+        "Set publicly_accessible = false.")
+def rds_public(st: AWSState):
+    for db in st.rds_instances:
+        if db.publicly_accessible.bool():
+            yield CloudFailure(
+                "Instance is exposed publicly.",
+                db.publicly_accessible,
+                db.address,
+            )
+
+
+@_check("AVD-AWS-0077", "RDS Cluster and RDS instance should have backup retention longer than default 1 day", "MEDIUM", "rds",
+        "Short retention windows limit point-in-time recovery.",
+        "Set backup_retention_period greater than 1.")
+def rds_backup_retention(st: AWSState):
+    for db in st.rds_instances:
+        if db.backup_retention.int() <= 1:
+            yield CloudFailure(
+                "Instance has very low backup retention.",
+                db.backup_retention if db.backup_retention.explicit else db.anchor(),
+                db.address,
+            )
+
+
+@_check("AVD-AWS-0133", "RDS Performance Insights Encryption", "LOW", "rds",
+        "Performance Insights data should use a customer key.",
+        "Set performance_insights_kms_key_id when insights are enabled.")
+def rds_insights_kms(st: AWSState):
+    for db in st.rds_instances:
+        if db.performance_insights.bool() and not db.performance_insights_kms.str():
+            yield CloudFailure(
+                "Instance has performance insights enabled without a customer managed key.",
+                db.performance_insights,
+                db.address,
+            )
+
+
+# -- CloudTrail --------------------------------------------------------------
+
+@_check("AVD-AWS-0014", "CloudTrail Multi Region", "MEDIUM", "cloudtrail",
+        "Single-region trails miss events elsewhere.",
+        "Set is_multi_region_trail = true.")
+def trail_multi_region(st: AWSState):
+    for t in st.cloudtrails:
+        if not t.multi_region.bool():
+            yield CloudFailure(
+                "Trail is not enabled across all regions.",
+                t.multi_region if t.multi_region.explicit else t.anchor(),
+                t.address,
+            )
+
+
+@_check("AVD-AWS-0016", "CloudTrail Log File Validation", "HIGH", "cloudtrail",
+        "Validation detects tampering with delivered logs.",
+        "Set enable_log_file_validation = true.")
+def trail_validation(st: AWSState):
+    for t in st.cloudtrails:
+        if not t.log_validation.bool():
+            yield CloudFailure(
+                "Trail does not have log validation enabled.",
+                t.log_validation if t.log_validation.explicit else t.anchor(),
+                t.address,
+            )
+
+
+@_check("AVD-AWS-0015", "CloudTrail Encryption", "HIGH", "cloudtrail",
+        "Trail logs should be encrypted with a customer managed key.",
+        "Set kms_key_id on the trail.")
+def trail_cmk(st: AWSState):
+    for t in st.cloudtrails:
+        if not t.kms_key_id.str():
+            yield CloudFailure(
+                "Trail is not encrypted with a customer managed key.",
+                t.kms_key_id if t.kms_key_id.explicit else t.anchor(),
+                t.address,
+            )
+
+
+# -- IAM ---------------------------------------------------------------------
+
+def _statements(doc) -> list[dict]:
+    if not isinstance(doc, dict):
+        return []
+    stmts = doc.get("Statement", [])
+    if isinstance(stmts, dict):
+        stmts = [stmts]
+    return [s for s in stmts if isinstance(s, dict)]
+
+
+@_check("AVD-AWS-0057", "IAM policy should avoid use of wildcards and instead apply the principle of least privilege", "HIGH", "iam",
+        "Wildcard actions/resources grant more than intended.",
+        "Scope actions and resources explicitly.", targets="iam_policies")
+def iam_wildcards(st: AWSState):
+    for p in st.iam_policies:
+        for s in _statements(p.document.value):
+            if s.get("Effect", "Allow") != "Allow":
+                continue
+            actions = s.get("Action", [])
+            actions = actions if isinstance(actions, list) else [actions]
+            resources = s.get("Resource", [])
+            resources = resources if isinstance(resources, list) else [resources]
+            for a in actions:
+                if isinstance(a, str) and a.strip() == "*":
+                    yield CloudFailure(
+                        "IAM policy document uses wildcarded action '*'",
+                        p.document, p.address,
+                    )
+                    break
+            else:
+                for r in resources:
+                    if isinstance(r, str) and r.strip() == "*":
+                        yield CloudFailure(
+                            "IAM policy document uses sensitive action '*' on wildcarded resource '*'"
+                            if any(isinstance(a, str) and ":" in a for a in actions)
+                            else "IAM policy document uses wildcarded resource '*'",
+                            p.document, p.address,
+                        )
+                        break
+
+
+@_check("AVD-AWS-0063", "IAM Password policy should have minimum password length of 14 or more characters", "MEDIUM", "iam",
+        "Short passwords are easier to brute force.",
+        "Set minimum_password_length >= 14.", targets="password_policies")
+def iam_password_length(st: AWSState):
+    for p in st.password_policies:
+        if p.minimum_length.int() < 14:
+            yield CloudFailure(
+                "Password policy allows a maximum password age of less than 14 characters.",
+                p.minimum_length if p.minimum_length.explicit else p.anchor(),
+                p.address,
+            )
+
+
+@_check("AVD-AWS-0059", "IAM Password policy should prevent password reuse", "MEDIUM", "iam",
+        "Reused passwords extend the life of compromised credentials.",
+        "Set password_reuse_prevention >= 5.", targets="password_policies")
+def iam_password_reuse(st: AWSState):
+    for p in st.password_policies:
+        if p.reuse_prevention.int() < 5:
+            yield CloudFailure(
+                "Password policy allows reuse of recent passwords.",
+                p.reuse_prevention if p.reuse_prevention.explicit else p.anchor(),
+                p.address,
+            )
+
+
+@_check("AVD-AWS-0062", "IAM Password policy should have expiry less than or equal to 90 days", "MEDIUM", "iam",
+        "Long-lived passwords increase exposure.",
+        "Set max_password_age <= 90.", targets="password_policies")
+def iam_password_age(st: AWSState):
+    for p in st.password_policies:
+        age = p.max_age.int()
+        if age == 0 or age > 90:
+            yield CloudFailure(
+                "Password policy allows passwords to live longer than 90 days.",
+                p.max_age if p.max_age.explicit else p.anchor(),
+                p.address,
+            )
+
+
+@_check("AVD-AWS-0060", "IAM Password policy should have requirement for at least one symbol in the password", "MEDIUM", "iam",
+        "Symbols increase password entropy.",
+        "Set require_symbols = true.", targets="password_policies")
+def iam_password_symbols(st: AWSState):
+    for p in st.password_policies:
+        if not p.require_symbols.bool():
+            yield CloudFailure(
+                "Password policy does not require symbols.",
+                p.require_symbols if p.require_symbols.explicit else p.anchor(),
+                p.address,
+            )
+
+
+@_check("AVD-AWS-0061", "IAM Password policy should have requirement for at least one number in the password", "MEDIUM", "iam",
+        "Numbers increase password entropy.",
+        "Set require_numbers = true.", targets="password_policies")
+def iam_password_numbers(st: AWSState):
+    for p in st.password_policies:
+        if not p.require_numbers.bool():
+            yield CloudFailure(
+                "Password policy does not require numbers.",
+                p.require_numbers if p.require_numbers.explicit else p.anchor(),
+                p.address,
+            )
+
+
+# -- EKS ---------------------------------------------------------------------
+
+@_check("AVD-AWS-0038", "EKS Clusters should have cluster control plane logging turned on", "MEDIUM", "eks",
+        "Control plane logs are needed for audit and forensics.",
+        "Enable all control-plane log types.")
+def eks_logging(st: AWSState):
+    for c in st.eks_clusters:
+        if not c.log_types.list():
+            yield CloudFailure(
+                "Cluster does not have control plane logging enabled.",
+                c.log_types if c.log_types.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0039", "EKS should have the encryption of secrets enabled", "HIGH", "eks",
+        "Secrets should be envelope-encrypted with KMS.",
+        "Add an encryption_config with resources = [\"secrets\"].")
+def eks_secrets(st: AWSState):
+    for c in st.eks_clusters:
+        if not c.secrets_encrypted.bool():
+            yield CloudFailure(
+                "Cluster does not have secret encryption enabled.",
+                c.secrets_encrypted if c.secrets_encrypted.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0040", "EKS Clusters should have the public access disabled", "CRITICAL", "eks",
+        "A public API endpoint is reachable from the internet.",
+        "Set endpoint_public_access = false.")
+def eks_public_access(st: AWSState):
+    for c in st.eks_clusters:
+        if c.public_access.bool(True):
+            yield CloudFailure(
+                "Public cluster access is enabled.",
+                c.public_access if c.public_access.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0041", "EKS Clusters should restrict access to public API server", "CRITICAL", "eks",
+        "Public API access should be restricted to known CIDRs.",
+        "Restrict public_access_cidrs.")
+def eks_public_cidrs(st: AWSState):
+    for c in st.eks_clusters:
+        if not c.public_access.bool(True):
+            continue
+        cidrs = c.public_access_cidrs.list()
+        if any(isinstance(x, str) and _is_public_cidr(x) for x in cidrs):
+            yield CloudFailure(
+                "Cluster allows access from a public CIDR: 0.0.0.0/0.",
+                c.public_access_cidrs if c.public_access_cidrs.explicit else c.anchor(),
+                c.address,
+            )
+
+
+# -- KMS / messaging ---------------------------------------------------------
+
+@_check("AVD-AWS-0065", "A KMS key is not configured to auto-rotate", "MEDIUM", "kms",
+        "Rotation limits blast radius of a compromised key.",
+        "Set enable_key_rotation = true.")
+def kms_rotation(st: AWSState):
+    for k in st.kms_keys:
+        if k.usage.str() == "SIGN_VERIFY":
+            continue
+        if not k.rotation_enabled.bool():
+            yield CloudFailure(
+                "Key does not have rotation enabled.",
+                k.rotation_enabled if k.rotation_enabled.explicit else k.anchor(),
+                k.address,
+            )
+
+
+@_check("AVD-AWS-0095", "SNS topic not encrypt data with a customer managed key.", "HIGH", "sns",
+        "Topics should be encrypted with a CMK.",
+        "Set kms_master_key_id.")
+def sns_encryption(st: AWSState):
+    for t in st.sns_topics:
+        if not t.kms_key_id.str():
+            yield CloudFailure(
+                "Topic does not have encryption enabled.",
+                t.kms_key_id if t.kms_key_id.explicit else t.anchor(),
+                t.address,
+            )
+
+
+@_check("AVD-AWS-0096", "Unencrypted SQS queue.", "HIGH", "sqs",
+        "Queues should be encrypted at rest.",
+        "Enable SSE-SQS or set kms_master_key_id.")
+def sqs_encryption(st: AWSState):
+    for q in st.sqs_queues:
+        if not q.managed_sse.bool() and not q.kms_key_id.str():
+            yield CloudFailure(
+                "Queue is not encrypted",
+                q.kms_key_id if q.kms_key_id.explicit else q.anchor(),
+                q.address,
+            )
+
+
+@_check("AVD-AWS-0097", "AWS SQS policy document has wildcard action statement.", "HIGH", "sqs",
+        "Wildcard actions on queue policies grant unintended rights.",
+        "Scope queue policy actions.")
+def sqs_policy_wildcard(st: AWSState):
+    for q in st.sqs_queues:
+        for s in _statements(q.policy_document.value):
+            if s.get("Effect", "Allow") != "Allow":
+                continue
+            actions = s.get("Action", [])
+            actions = actions if isinstance(actions, list) else [actions]
+            if any(isinstance(a, str) and a in ("*", "sqs:*") for a in actions):
+                yield CloudFailure(
+                    "Queue policy does not restrict actions as required.",
+                    q.policy_document, q.address,
+                )
+
+
+# -- ELB ---------------------------------------------------------------------
+
+@_check("AVD-AWS-0053", "Load balancer is exposed to the internet.", "HIGH", "elb",
+        "Internet-facing load balancers expose workloads.",
+        "Set internal = true unless public exposure is intended.", targets="load_balancers")
+def elb_internal(st: AWSState):
+    for lb in st.load_balancers:
+        if not lb.internal.bool():
+            yield CloudFailure(
+                "Load balancer is exposed publicly.",
+                lb.internal if lb.internal.explicit else lb.anchor(),
+                lb.address,
+            )
+
+
+@_check("AVD-AWS-0052", "Load balancers should drop invalid headers", "HIGH", "elb",
+        "Dropping invalid headers mitigates request smuggling.",
+        "Set drop_invalid_header_fields = true.", targets="load_balancers")
+def elb_drop_headers(st: AWSState):
+    for lb in st.load_balancers:
+        if lb.type.str() != "application":
+            continue
+        if not lb.drop_invalid_headers.bool():
+            yield CloudFailure(
+                "Application load balancer is not set to drop invalid headers.",
+                lb.drop_invalid_headers if lb.drop_invalid_headers.explicit else lb.anchor(),
+                lb.address,
+            )
+
+
+@_check("AVD-AWS-0054", "Use of plain HTTP.", "CRITICAL", "elb",
+        "Plain HTTP traffic can be read and modified in transit.",
+        "Use HTTPS with a certificate.", targets="lb_listeners")
+def elb_http(st: AWSState):
+    for l in st.lb_listeners:
+        if l.protocol.str().upper() == "HTTP":
+            yield CloudFailure(
+                "Listener for application load balancer does not use HTTPS.",
+                l.protocol if l.protocol.explicit else l.anchor(),
+                l.address,
+            )
+
+
+_OUTDATED_TLS = {
+    "ELBSecurityPolicy-2015-05", "ELBSecurityPolicy-2016-08",
+    "ELBSecurityPolicy-TLS-1-0-2015-04", "ELBSecurityPolicy-TLS-1-1-2017-01",
+}
+
+
+@_check("AVD-AWS-0047", "Use of outdated SSL policy.", "CRITICAL", "elb",
+        "Old TLS policies permit weak protocol versions.",
+        "Use a TLS 1.2+ security policy.", targets="lb_listeners")
+def elb_tls_policy(st: AWSState):
+    for l in st.lb_listeners:
+        if l.ssl_policy.str() in _OUTDATED_TLS:
+            yield CloudFailure(
+                f"Listener uses an outdated TLS policy: {l.ssl_policy.str()}.",
+                l.ssl_policy, l.address,
+            )
+
+
+# -- ECR / storage services --------------------------------------------------
+
+@_check("AVD-AWS-0030", "ECR repository has image scans disabled.", "HIGH", "ecr",
+        "Image scanning surfaces known vulnerabilities on push.",
+        "Enable scan_on_push.")
+def ecr_scanning(st: AWSState):
+    for r in st.ecr_repositories:
+        if not r.scan_on_push.bool():
+            yield CloudFailure(
+                "Image scanning is not enabled.",
+                r.scan_on_push if r.scan_on_push.explicit else r.anchor(),
+                r.address,
+            )
+
+
+@_check("AVD-AWS-0031", "ECR images tags shouldn't be mutable.", "HIGH", "ecr",
+        "Mutable tags allow silently replacing deployed images.",
+        "Set image_tag_mutability = \"IMMUTABLE\".")
+def ecr_immutable(st: AWSState):
+    for r in st.ecr_repositories:
+        if not r.immutable_tags.bool():
+            yield CloudFailure(
+                "Repository tags are mutable.",
+                r.immutable_tags if r.immutable_tags.explicit else r.anchor(),
+                r.address,
+            )
+
+
+@_check("AVD-AWS-0033", "ECR Repo is not encrypted with KMS.", "LOW", "ecr",
+        "Customer-managed keys give control over repo encryption.",
+        "Use encryption_type = \"KMS\".")
+def ecr_kms(st: AWSState):
+    for r in st.ecr_repositories:
+        if not r.encrypted_kms.bool():
+            yield CloudFailure(
+                "Repository is not encrypted using KMS.",
+                r.encrypted_kms if r.encrypted_kms.explicit else r.anchor(),
+                r.address,
+            )
+
+
+@_check("AVD-AWS-0037", "EFS Encryption", "HIGH", "efs",
+        "EFS file systems should be encrypted at rest.",
+        "Set encrypted = true.")
+def efs_encrypted(st: AWSState):
+    for f in st.efs_filesystems:
+        if not f.encrypted.bool():
+            yield CloudFailure(
+                "File system is not encrypted.",
+                f.encrypted if f.encrypted.explicit else f.anchor(),
+                f.address,
+            )
+
+
+@_check("AVD-AWS-0051", "Elasticache Replication Group uses unencrypted traffic.", "HIGH", "elasticache",
+        "In-transit encryption protects replication traffic.",
+        "Set transit_encryption_enabled = true.")
+def elasticache_transit(st: AWSState):
+    for g in st.elasticache_groups:
+        if not g.transit_encryption.bool():
+            yield CloudFailure(
+                "Replication group does not have transit encryption enabled.",
+                g.transit_encryption if g.transit_encryption.explicit else g.anchor(),
+                g.address,
+            )
+
+
+@_check("AVD-AWS-0045", "Elasticache Replication Group stores unencrypted data at-rest.", "HIGH", "elasticache",
+        "At-rest encryption protects cached data.",
+        "Set at_rest_encryption_enabled = true.")
+def elasticache_at_rest(st: AWSState):
+    for g in st.elasticache_groups:
+        if not g.at_rest_encryption.bool():
+            yield CloudFailure(
+                "Replication group does not have at-rest encryption enabled.",
+                g.at_rest_encryption if g.at_rest_encryption.explicit else g.anchor(),
+                g.address,
+            )
+
+
+@_check("AVD-AWS-0084", "Redshift clusters should use at rest encryption", "HIGH", "redshift",
+        "Unencrypted clusters expose warehouse data.",
+        "Set encrypted = true with a KMS key.")
+def redshift_encrypted(st: AWSState):
+    for c in st.redshift_clusters:
+        if not c.encrypted.bool():
+            yield CloudFailure(
+                "Cluster does not have encryption enabled.",
+                c.encrypted if c.encrypted.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0024", "Point in time recovery in DynamoDB", "MEDIUM", "dynamodb",
+        "PITR protects tables against accidental writes/deletes.",
+        "Enable point-in-time recovery.")
+def dynamodb_pitr(st: AWSState):
+    for t in st.dynamodb_tables:
+        if not t.point_in_time_recovery.bool():
+            yield CloudFailure(
+                "Table does not have point in time recovery enabled.",
+                t.point_in_time_recovery if t.point_in_time_recovery.explicit else t.anchor(),
+                t.address,
+            )
+
+
+@_check("AVD-AWS-0025", "DynamoDB tables should use at rest encryption with a Customer Managed Key", "LOW", "dynamodb",
+        "CMK-based encryption gives control over table data keys.",
+        "Enable server-side encryption with a KMS key.")
+def dynamodb_sse(st: AWSState):
+    for t in st.dynamodb_tables:
+        if not t.sse_enabled.bool():
+            yield CloudFailure(
+                "Table encryption does not use a customer-managed KMS key.",
+                t.sse_enabled if t.sse_enabled.explicit else t.anchor(),
+                t.address,
+            )
+
+
+# -- CloudFront / Lambda ------------------------------------------------------
+
+@_check("AVD-AWS-0010", "CloudFront distribution allows unencrypted (HTTP) communications.", "CRITICAL", "cloudfront",
+        "Viewers should be redirected to HTTPS.",
+        "Set viewer_protocol_policy to redirect-to-https or https-only.")
+def cloudfront_https(st: AWSState):
+    for d in st.cloudfront_distributions:
+        if d.viewer_protocol_policy.str() == "allow-all":
+            yield CloudFailure(
+                "Distribution allows unencrypted communications.",
+                d.viewer_protocol_policy if d.viewer_protocol_policy.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0013", "CloudFront distribution uses outdated SSL/TLS protocols.", "HIGH", "cloudfront",
+        "Minimum protocol should be TLS 1.2.",
+        "Set minimum_protocol_version to TLSv1.2_2021.")
+def cloudfront_tls(st: AWSState):
+    for d in st.cloudfront_distributions:
+        mpv = d.minimum_protocol_version.str()
+        if mpv and not mpv.startswith("TLSv1.2"):
+            yield CloudFailure(
+                f"Distribution allows outdated SSL/TLS protocols ({mpv}).",
+                d.minimum_protocol_version if d.minimum_protocol_version.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0066", "Lambda functions should have X-Ray tracing enabled", "LOW", "lambda",
+        "Tracing aids investigation of anomalous behavior.",
+        "Set tracing_config mode = \"Active\".")
+def lambda_tracing(st: AWSState):
+    for f in st.lambda_functions:
+        if f.tracing_mode.str() != "Active":
+            yield CloudFailure(
+                "Function does not have tracing enabled.",
+                f.tracing_mode if f.tracing_mode.explicit else f.anchor(),
+                f.address,
+            )

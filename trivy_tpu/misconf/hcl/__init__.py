@@ -1,0 +1,7 @@
+"""HCL2 parsing and evaluation for terraform scanning
+(ref: pkg/iac/scanners/terraform/parser/ — independent implementation)."""
+
+from trivy_tpu.misconf.hcl.evaluator import Evaluator, truthy  # noqa: F401
+from trivy_tpu.misconf.hcl.functions import UNKNOWN, EvalError, is_unknown  # noqa: F401
+from trivy_tpu.misconf.hcl.parser import Body, Block, Attribute, parse, parse_expression  # noqa: F401
+from trivy_tpu.misconf.hcl.lexer import HclSyntaxError  # noqa: F401

@@ -45,6 +45,10 @@ def _span(node) -> tuple[int, int]:
 
 
 def _construct(node, loader):
+    # custom-tagged nodes (e.g. CloudFormation !Ref/!If) dispatch to the
+    # loader's registered constructor rather than the structural path
+    if node.tag and not node.tag.startswith("tag:yaml.org,2002:"):
+        return loader.construct_object(node, deep=True)
     if isinstance(node, yaml.MappingNode):
         out = LMap()
         out.span = _span(node)

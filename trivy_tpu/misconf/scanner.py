@@ -1,7 +1,18 @@
 """Misconfiguration scanner facade (ref: pkg/misconf/scanner.go:101-141).
 
-Routes files by detected type to the matching parser + check set and
-produces ``types.Misconfiguration`` records with the reference's
+Routes files by detected type to the matching engine:
+
+- dockerfile / kubernetes  → per-file structural checks (DS*/KSV*)
+- terraform                → whole-file-set HCL evaluation → AWS state →
+                             cloud checks (AVD-AWS-*)
+- cloudformation           → per-file template resolution → same cloud checks
+- helm                     → template render → kubernetes checks
+- azure-arm                → template resolution → ARM checks
+- yaml / json              → user-supplied custom checks only (matching the
+                             reference's generic scanners, which evaluate
+                             nothing without custom policies)
+
+and produces ``types.Misconfiguration`` records with the reference's
 successes/failures/CauseMetadata shape (ref: scanner.go:443-499).
 """
 
@@ -11,7 +22,7 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import log
 from trivy_tpu.misconf import detection
-from trivy_tpu.misconf.checks import evaluate
+from trivy_tpu.misconf.checks import evaluate, evaluate_cloud
 from trivy_tpu.types import Misconfiguration
 
 logger = log.logger("misconf")
@@ -36,21 +47,68 @@ class ScannerOption:
     namespaces: list[str] = field(default_factory=list)
     include_non_failures: bool = False
     check_ids_disabled: list[str] = field(default_factory=list)
+    check_paths: list[str] = field(default_factory=list)  # custom check files/dirs
 
 
 class MisconfScanner:
     def __init__(self, option: ScannerOption | None = None):
         self.option = option or ScannerOption()
         self._disabled = set(self.option.check_ids_disabled)
+        if self.option.check_paths:
+            from trivy_tpu.misconf.custom import load_custom_checks
 
-    def scan_file(self, path: str, content: bytes) -> Misconfiguration | None:
-        try:
-            ftype = detection.detect_type(path, content)
-        except Exception as e:  # one undetectable file must not kill the batch
-            logger.debug("misconf type detection failed for %s: %s", path, e)
-            return None
+            load_custom_checks(self.option.check_paths)
+
+    def _enabled(self, c) -> bool:
+        return c.id not in self._disabled and c.avd_id not in self._disabled
+
+    def scan_files(self, files: list[tuple[str, bytes]]) -> list[Misconfiguration]:
+        tf_files: dict[str, bytes] = {}
+        helm_files: dict[str, bytes] = {}
+        per_file: list[tuple[str, str, bytes]] = []
+        for path, content in files:
+            try:
+                ftype = detection.detect_type(path, content)
+            except Exception as e:  # one bad file must not kill the batch
+                logger.debug("misconf type detection failed for %s: %s", path, e)
+                continue
+            if ftype is None:
+                continue
+            if ftype == detection.FILE_TYPE_TERRAFORM:
+                tf_files[path] = content
+            elif ftype == detection.FILE_TYPE_HELM:
+                helm_files[path] = content
+            else:
+                per_file.append((path, ftype, content))
+
+        out: list[Misconfiguration] = []
+        if tf_files:
+            out.extend(self._scan_terraform(tf_files))
+        if helm_files:
+            out.extend(self._scan_helm(helm_files))
+        for path, ftype, content in per_file:
+            mc = self.scan_file(path, content, ftype)
+            if mc is not None:
+                out.append(mc)
+        out = [mc for mc in out if mc.failures or mc.successes]
+        out.sort(key=lambda m: m.file_path)
+        return out
+
+    # -- single-file types ---------------------------------------------------
+
+    def scan_file(self, path: str, content: bytes, ftype: str | None = None) -> Misconfiguration | None:
+        if ftype is None:
+            try:
+                ftype = detection.detect_type(path, content)
+            except Exception as e:
+                logger.debug("misconf type detection failed for %s: %s", path, e)
+                return None
         if ftype is None:
             return None
+        if ftype == detection.FILE_TYPE_CLOUDFORMATION:
+            return self._scan_cloudformation(path, content)
+        if ftype == detection.FILE_TYPE_AZURE_ARM:
+            return self._scan_arm(path, content)
         try:
             parsed = self._parse(ftype, content)
         except Exception as e:
@@ -63,17 +121,88 @@ class MisconfScanner:
             path,
             parsed,
             _SCANNER_NAMES.get(ftype, ftype),
-            enabled=lambda c: c.id not in self._disabled,
+            enabled=self._enabled,
         )
 
-    def scan_files(self, files: list[tuple[str, bytes]]) -> list[Misconfiguration]:
-        out = []
-        for path, content in files:
-            mc = self.scan_file(path, content)
-            if mc is not None and (mc.failures or mc.successes):
+    # -- engines -------------------------------------------------------------
+
+    def _scan_terraform(self, tf_files: dict[str, bytes]) -> list[Misconfiguration]:
+        from trivy_tpu.misconf import terraform
+        from trivy_tpu.misconf.adapters import aws_tf
+
+        try:
+            texts = {
+                p: c.decode("utf-8", "replace") for p, c in tf_files.items()
+            }
+            resources = terraform.load(texts)
+            state = aws_tf.adapt(resources)
+        except Exception as e:
+            logger.warning("terraform evaluation failed: %s", e)
+            return []
+        by_file = evaluate_cloud(
+            state,
+            sorted(tf_files),
+            detection.FILE_TYPE_TERRAFORM,
+            _SCANNER_NAMES[detection.FILE_TYPE_TERRAFORM],
+            enabled=self._enabled,
+        )
+        return list(by_file.values())
+
+    def _scan_cloudformation(self, path: str, content: bytes) -> Misconfiguration | None:
+        from trivy_tpu.misconf import cloudformation
+        from trivy_tpu.misconf.adapters import aws_cfn
+
+        try:
+            resources = cloudformation.load(path, content)
+            state = aws_cfn.adapt(resources)
+        except Exception as e:
+            logger.debug("cloudformation evaluation failed for %s: %s", path, e)
+            return None
+        by_file = evaluate_cloud(
+            state,
+            [path],
+            detection.FILE_TYPE_CLOUDFORMATION,
+            _SCANNER_NAMES[detection.FILE_TYPE_CLOUDFORMATION],
+            enabled=self._enabled,
+        )
+        return by_file.get(path)
+
+    def _scan_helm(self, helm_files: dict[str, bytes]) -> list[Misconfiguration]:
+        from trivy_tpu.misconf import helm
+        from trivy_tpu.misconf.parse import kubernetes
+
+        out: list[Misconfiguration] = []
+        try:
+            rendered = helm.render_charts(helm_files)
+        except Exception as e:
+            logger.warning("helm render failed: %s", e)
+            return []
+        for path, text in rendered.items():
+            try:
+                workloads = kubernetes.parse(text.encode())
+            except Exception as e:
+                logger.debug("helm-rendered manifest parse failed for %s: %s", path, e)
+                continue
+            mc = evaluate(
+                detection.FILE_TYPE_KUBERNETES,
+                path,
+                workloads,
+                _SCANNER_NAMES[detection.FILE_TYPE_HELM],
+                enabled=self._enabled,
+            )
+            if mc is not None:
+                mc.file_type = detection.FILE_TYPE_HELM
                 out.append(mc)
-        out.sort(key=lambda m: m.file_path)
         return out
+
+    def _scan_arm(self, path: str, content: bytes) -> Misconfiguration | None:
+        from trivy_tpu.misconf import arm
+
+        try:
+            return arm.scan(path, content, enabled=self._enabled)
+        except Exception as e:
+            logger.debug("ARM evaluation failed for %s: %s", path, e)
+            return None
 
     @staticmethod
     def _parse(ftype: str, content: bytes):
@@ -85,6 +214,13 @@ class MisconfScanner:
             from trivy_tpu.misconf.parse import kubernetes
 
             return kubernetes.parse(content)
-        # yaml/json/terraform/cloudformation/helm: parsed views exist for
-        # custom checks; no builtin check set yet -> nothing to evaluate
+        if ftype in (detection.FILE_TYPE_YAML, detection.FILE_TYPE_JSON):
+            # generic types evaluate only user-supplied custom checks
+            # (ref: pkg/iac/scanners/generic — no builtin bundle)
+            from trivy_tpu.misconf.checks import checks_for
+            from trivy_tpu.misconf.parse import yamljson
+
+            if not checks_for(ftype):
+                return None
+            return yamljson.load_all(content)
         return None
