@@ -1,0 +1,432 @@
+"""IaC subsystem tests: HCL evaluation, terraform, CloudFormation, ARM,
+helm, custom checks, and scanner routing.
+
+Mirrors the reference's scanner test strategy (fixture trees → findings
+with line causes; ref: pkg/iac/scanners/terraform/parser/parser_test.go,
+pkg/iac/scanners/cloudformation/parser/parser_test.go).
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from trivy_tpu.misconf import arm, cloudformation, detection, terraform
+from trivy_tpu.misconf.adapters import aws_cfn, aws_tf
+from trivy_tpu.misconf.hcl import Evaluator
+from trivy_tpu.misconf.scanner import MisconfScanner, ScannerOption
+
+
+def _tf(src: str) -> dict:
+    return {"main.tf": textwrap.dedent(src)}
+
+
+def _scan(files: dict[str, str], **opt) -> list:
+    scanner = MisconfScanner(ScannerOption(**opt))
+    return scanner.scan_files([(p, s.encode()) for p, s in files.items()])
+
+
+def _failures(mcs) -> list:
+    return [f for mc in mcs for f in mc.failures]
+
+
+# ---------------------------------------------------------------------------
+# HCL expression evaluation
+# ---------------------------------------------------------------------------
+
+
+class TestHCLEvaluator:
+    def eval(self, src: str, scope=None):
+        return Evaluator(scope=scope or {}).eval_src(src)
+
+    def test_arithmetic_and_precedence(self):
+        assert self.eval("1 + 2 * 3") == 7
+        assert self.eval("(1 + 2) * 3") == 9
+        assert self.eval("10 % 3") == 1
+
+    def test_comparison_and_logic(self):
+        assert self.eval("1 < 2 && 2 <= 2") is True
+        assert self.eval("!(1 == 2) || false") is True
+
+    def test_conditional(self):
+        assert self.eval('true ? "a" : "b"') == "a"
+
+    def test_string_template(self):
+        assert self.eval('"x-${1 + 1}"') == "x-2"
+
+    def test_collections(self):
+        assert self.eval("[1, 2, 3][1]") == 2
+        assert self.eval('{ a = 1, b = 2 }["b"]') == 2
+
+    def test_for_expression(self):
+        assert self.eval("[for x in [1, 2, 3] : x * 2]") == [2, 4, 6]
+        assert self.eval("[for x in [1, 2, 3] : x if x > 1]") == [2, 3]
+        assert self.eval('{ for k, v in { a = 1 } : upper(k) => v }') == {"A": 1}
+
+    def test_functions(self):
+        assert self.eval('length("abc")') == 3
+        assert self.eval('join("-", ["a", "b"])') == "a-b"
+        assert self.eval('upper("abc")') == "ABC"
+        assert self.eval('contains(["a"], "a")') is True
+        assert self.eval("max(1, 5, 2)") == 5
+        assert self.eval('split(",", "a,b")') == ["a", "b"]
+        assert self.eval('coalesce(null, "x")') == "x"
+        assert self.eval('lookup({ a = 1 }, "a", 0)') == 1
+        assert self.eval('lookup({}, "a", 9)') == 9
+
+    def test_splat(self):
+        scope = {"things": [{"id": 1}, {"id": 2}]}
+        assert self.eval("things[*].id", scope) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# terraform evaluation
+# ---------------------------------------------------------------------------
+
+
+class TestTerraform:
+    def test_variables_and_locals(self):
+        res = terraform.load(_tf("""
+            variable "name" { default = "data" }
+            locals { full = "${var.name}-bucket" }
+            resource "aws_s3_bucket" "b" { bucket = local.full }
+        """))
+        assert res[0].get("bucket").value == "data-bucket"
+
+    def test_tfvars_override_default(self):
+        res = terraform.load({
+            "main.tf": 'variable "env" { default = "dev" }\n'
+                       'resource "aws_s3_bucket" "b" { bucket = var.env }\n',
+            "terraform.tfvars": 'env = "prod"\n',
+        })
+        assert res[0].get("bucket").value == "prod"
+
+    def test_count_expansion(self):
+        res = terraform.load(_tf("""
+            resource "aws_s3_bucket" "b" {
+              count  = 2
+              bucket = "b-${count.index}"
+            }
+        """))
+        names = sorted(r.get("bucket").value for r in res)
+        assert names == ["b-0", "b-1"]
+
+    def test_for_each_expansion(self):
+        res = terraform.load(_tf("""
+            resource "aws_s3_bucket" "b" {
+              for_each = { x = "1", y = "2" }
+              bucket   = "${each.key}-${each.value}"
+            }
+        """))
+        names = sorted(r.get("bucket").value for r in res)
+        assert names == ["x-1", "y-2"]
+
+    def test_cross_resource_reference(self):
+        res = terraform.load(_tf("""
+            resource "aws_s3_bucket" "b" { bucket = "data" }
+            resource "aws_s3_bucket_public_access_block" "p" {
+              bucket              = aws_s3_bucket.b.id
+              block_public_acls   = true
+            }
+        """))
+        state = aws_tf.adapt(res)
+        assert len(state.s3_buckets) == 1
+        pab = state.s3_buckets[0].public_access_block
+        assert pab is not None
+        assert pab.block_public_acls.bool() is True
+
+    def test_dynamic_block(self):
+        res = terraform.load(_tf("""
+            resource "aws_security_group" "sg" {
+              dynamic "ingress" {
+                for_each = [22, 80]
+                content {
+                  from_port   = ingress.value
+                  to_port     = ingress.value
+                  cidr_blocks = ["0.0.0.0/0"]
+                }
+              }
+            }
+        """))
+        state = aws_tf.adapt(res)
+        ports = sorted(
+            r.from_port.int() for g in state.security_groups for r in g.rules
+        )
+        assert ports == [22, 80]
+
+    def test_line_causes_e2e(self):
+        mcs = _scan({"main.tf": (
+            'resource "aws_instance" "i" {\n'
+            "  metadata_options {\n"
+            '    http_tokens = "optional"\n'
+            "  }\n"
+            "}\n"
+        )})
+        fails = [f for f in _failures(mcs) if f.id == "AVD-AWS-0028"]
+        assert fails and fails[0].start_line == 3
+
+
+# ---------------------------------------------------------------------------
+# CloudFormation
+# ---------------------------------------------------------------------------
+
+
+CFN_YAML = """\
+AWSTemplateFormatVersion: "2010-09-09"
+Parameters:
+  Env:
+    Type: String
+    Default: prod
+Mappings:
+  RegionMap:
+    us-east-1:
+      Ami: ami-123
+Conditions:
+  IsProd: !Equals [!Ref Env, prod]
+Resources:
+  B:
+    Type: AWS::S3::Bucket
+    Properties:
+      BucketName: !Sub "${Env}-data"
+  I:
+    Type: AWS::EC2::Instance
+    Properties:
+      ImageId: !FindInMap [RegionMap, us-east-1, Ami]
+      Tags:
+        - Key: joined
+          Value: !Join ["-", [!Ref Env, "x"]]
+"""
+
+
+class TestCloudFormation:
+    def test_intrinsics(self):
+        blocks = cloudformation.load("t.yaml", CFN_YAML.encode())
+        by_name = {b.labels[0]: b for b in blocks}
+        assert by_name["B"].get("BucketName").value == "prod-data"
+        assert by_name["I"].get("ImageId").value == "ami-123"
+
+    def test_json_template(self):
+        src = (
+            '{"Resources": {"B": {"Type": "AWS::S3::Bucket",'
+            ' "Properties": {"BucketName": {"Fn::Join": ["-", ["a", "b"]]}}}}}'
+        )
+        blocks = cloudformation.load("t.json", src.encode())
+        assert blocks[0].get("BucketName").value == "a-b"
+
+    def test_detection_with_short_tags(self):
+        assert detection.detect_type("t.yaml", CFN_YAML.encode()) == "cloudformation"
+
+    def test_e2e_line_causes(self):
+        mcs = _scan({"stack.yaml": CFN_YAML})
+        fails = _failures(mcs)
+        assert any(f.id.startswith("AVD-AWS") for f in fails)
+        assert all(f.start_line > 0 for f in fails)
+
+    def test_adapt_security_group(self):
+        src = textwrap.dedent("""
+            Resources:
+              Sg:
+                Type: AWS::EC2::SecurityGroup
+                Properties:
+                  SecurityGroupIngress:
+                    - IpProtocol: tcp
+                      FromPort: 22
+                      ToPort: 22
+                      CidrIp: 0.0.0.0/0
+        """)
+        state = aws_cfn.adapt(cloudformation.load("t.yaml", src.encode()))
+        assert state.security_groups
+        rule = state.security_groups[0].rules[0]
+        assert rule.cidrs.list() == ["0.0.0.0/0"]
+
+
+# ---------------------------------------------------------------------------
+# Azure ARM
+# ---------------------------------------------------------------------------
+
+
+ARM_TEMPLATE = """\
+{
+  "$schema": "https://schema.management.azure.com/schemas/2019-04-01/deploymentTemplate.json#",
+  "parameters": {"prefix": {"type": "string", "defaultValue": "corp"}},
+  "variables": {"name": "[toLower(concat(parameters('prefix'), 'Store'))]"},
+  "resources": [
+    {
+      "type": "Microsoft.Storage/storageAccounts",
+      "name": "[variables('name')]",
+      "properties": {
+        "supportsHttpsTrafficOnly": false,
+        "minimumTlsVersion": "TLS1_2"
+      }
+    }
+  ]
+}
+"""
+
+
+class TestARM:
+    def test_expressions(self):
+        blocks = arm.load("t.json", ARM_TEMPLATE.encode())
+        assert blocks[0].labels == ["corpstore"]
+
+    def test_expression_functions(self):
+        ctx = arm._Ctx({"p": "x"}, {})
+        ev = lambda s: arm._Parser(s, ctx).parse()  # noqa: E731
+        assert ev("concat('a', 'b', 1)") == "ab1"
+        assert ev("if(equals(1, 1), 'y', 'n')") == "y"
+        assert ev("format('{0}-{1}', 'a', 'b')") == "a-b"
+        assert ev("union(createArray('a'), createArray('b'))") == ["a", "b"]
+        assert ev("parameters('p')") == "x"
+
+    def test_scan_line_causes(self):
+        mc = arm.scan("t.json", ARM_TEMPLATE.encode())
+        by_id = {f.id: f for f in mc.failures}
+        assert "AVD-AZU-0008" in by_id
+        assert by_id["AVD-AZU-0008"].start_line == 10
+        # TLS1_2 set → no TLS failure
+        assert "AVD-AZU-0011" not in by_id
+
+    def test_detection(self):
+        assert detection.detect_type("t.json", ARM_TEMPLATE.encode()) == "azure-arm"
+
+    def test_malformed_expression_degrades_not_fatal(self):
+        src = ARM_TEMPLATE.replace('"TLS1_2"', '"[-]"')
+        mc = arm.scan("t.json", src.encode())
+        # the bad expression becomes UNKNOWN; other findings survive
+        assert any(f.id == "AVD-AZU-0008" for f in mc.failures)
+
+    def test_nested_container_adapted_once(self):
+        src = """\
+{
+  "resources": [
+    {
+      "type": "Microsoft.Storage/storageAccounts",
+      "name": "acct",
+      "properties": {"supportsHttpsTrafficOnly": true},
+      "resources": [
+        {
+          "type": "Microsoft.Storage/storageAccounts/blobServices/containers",
+          "name": "c",
+          "properties": {"publicAccess": "Blob"}
+        }
+      ]
+    }
+  ]
+}
+"""
+        state = arm.adapt(arm.load("t.json", src.encode()))
+        assert len(state.az_storage_accounts) == 1
+        assert len(state.az_storage_accounts[0].containers) == 1
+        mc = arm.scan("t.json", src.encode())
+        assert sum(1 for f in mc.failures if f.id == "AVD-AZU-0007") == 1
+
+
+# ---------------------------------------------------------------------------
+# custom checks
+# ---------------------------------------------------------------------------
+
+
+CUSTOM_CHECK = """\
+@check(id="TEST-USR-01", severity="HIGH", types=("yaml",), title="deny latest")
+def no_latest(docs):
+    for doc in docs:
+        if isinstance(doc, dict) and str(doc.get("image", "")).endswith(":latest"):
+            yield Failure("latest tag", start_line=doc.line("image"))
+
+
+@cloud_check(id="TEST-USR-02", severity="LOW", title="bucket tags",
+             targets="s3_buckets")
+def bucket_tags(state):
+    for b in state.s3_buckets:
+        if not b.resource.get("tags", None).is_set():
+            yield CloudFailure("untagged", val=b.anchor(), resource=b.address)
+"""
+
+
+class TestCustomChecks:
+    def test_generic_yaml_check(self, tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(CUSTOM_CHECK)
+        mcs = _scan(
+            {"app.yaml": "image: nginx:latest\n"}, check_paths=[str(p)]
+        )
+        fails = [f for f in _failures(mcs) if f.id == "TEST-USR-01"]
+        assert fails and fails[0].start_line == 1
+
+    def test_cloud_check(self, tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(CUSTOM_CHECK)
+        mcs = _scan(
+            {"main.tf": 'resource "aws_s3_bucket" "x" { bucket = "x" }\n'},
+            check_paths=[str(p)],
+        )
+        assert any(f.id == "TEST-USR-02" for f in _failures(mcs))
+
+    def test_bad_file_raises(self, tmp_path):
+        from trivy_tpu.misconf.custom import CustomCheckError, load_custom_checks
+
+        p = tmp_path / "bad.py"
+        p.write_text("this is not python ][")
+        with pytest.raises(CustomCheckError):
+            load_custom_checks([str(p)])
+
+    def test_rewritten_file_reloads(self, tmp_path):
+        from trivy_tpu.misconf import checks
+        from trivy_tpu.misconf.custom import load_custom_checks
+
+        p = tmp_path / "c.py"
+        p.write_text(
+            '@check(id="TEST-USR-RL", severity="LOW", types=("yaml",), title="v1")\n'
+            "def c(docs):\n    return\n    yield\n"
+        )
+        assert load_custom_checks([str(p)]) == 1
+        assert load_custom_checks([str(p)]) == 0  # unchanged: no-op
+        p.write_text(
+            '@check(id="TEST-USR-RL", severity="LOW", types=("yaml",), title="v2")\n'
+            "def c(docs):\n    return\n    yield\n"
+        )
+        assert load_custom_checks([str(p)]) == 1  # rewritten: re-registers
+        by_id = {c.id: c for c in checks.checks_for("yaml")}
+        assert by_id["TEST-USR-RL"].title == "v2"
+
+    def test_cloud_check_type_routing(self, tmp_path):
+        p = tmp_path / "c.py"
+        p.write_text(
+            '@cloud_check(id="TEST-USR-TF", severity="LOW", title="tf only",\n'
+            '             targets="s3_buckets", types=("terraform",))\n'
+            "def c(state):\n"
+            "    for b in state.s3_buckets:\n"
+            '        yield CloudFailure("x", val=b.anchor(), resource=b.address)\n'
+        )
+        cfn = "Resources:\n  B:\n    Type: AWS::S3::Bucket\n"
+        mcs = _scan({"stack.yaml": cfn}, check_paths=[str(p)])
+        assert not any(f.id == "TEST-USR-TF" for f in _failures(mcs))
+        mcs = _scan(
+            {"main.tf": 'resource "aws_s3_bucket" "x" { bucket = "x" }\n'},
+            check_paths=[str(p)],
+        )
+        assert any(f.id == "TEST-USR-TF" for f in _failures(mcs))
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_file_type_limit(self):
+        files = {
+            "main.tf": 'resource "aws_s3_bucket" "b" { bucket = "b" }\n',
+            "Dockerfile": "FROM scratch\n",
+        }
+        mcs = _scan(files, file_types=["dockerfile"])
+        assert all(mc.file_type == "dockerfile" for mc in mcs)
+
+    def test_one_bad_file_does_not_kill_batch(self):
+        files = {
+            "bad.yaml": "a: [unclosed\n",
+            "main.tf": 'resource "aws_instance" "i" { monitoring = false }\n',
+        }
+        mcs = _scan(files)
+        assert any(mc.file_type == "terraform" for mc in mcs)

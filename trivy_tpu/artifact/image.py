@@ -158,6 +158,7 @@ class ImageArchiveArtifact:
                 disabled=self.option.disabled_analyzers,
                 secret_config_path=self.option.secret_config_path,
                 backend=self.option.backend,
+                extra=self.option.analyzer_extra,
             )
         )
         self.handlers = HandlerManager()

@@ -33,6 +33,7 @@ class ArtifactOption:
     secret_config_path: str | None = None
     backend: str = "auto"
     insecure: bool = False
+    analyzer_extra: dict = field(default_factory=dict)
 
 
 class LocalFSArtifact:
@@ -48,6 +49,7 @@ class LocalFSArtifact:
                 secret_config_path=self.option.secret_config_path,
                 backend=self.option.backend,
                 root=root,
+                extra=self.option.analyzer_extra,
             )
         )
         self.handlers = HandlerManager()

@@ -92,6 +92,22 @@ def secret_flags() -> FlagGroup:
     )
 
 
+def misconf_flags() -> FlagGroup:
+    return FlagGroup(
+        "misconfiguration",
+        [
+            Flag("config-check", default=[], is_list=True,
+                 config_name="misconfiguration.config-check",
+                 help="paths to custom check files/dirs (Python check API)"),
+            Flag("misconfig-scanners", default=[], is_list=True,
+                 config_name="misconfiguration.scanners",
+                 choices=["dockerfile", "terraform", "cloudformation",
+                          "kubernetes", "helm", "azure-arm", "yaml", "json"],
+                 help="limit misconfig file types (e.g. terraform,dockerfile)"),
+        ],
+    )
+
+
 def license_flags() -> FlagGroup:
     return FlagGroup(
         "license",
@@ -131,13 +147,13 @@ def server_client_flags() -> FlagGroup:
 
 _TARGET_GROUPS = {
     "fs": [global_flags, scan_flags, report_flags, secret_flags, license_flags,
-           db_flags, server_client_flags],
+           misconf_flags, db_flags, server_client_flags],
     "rootfs": [global_flags, scan_flags, report_flags, secret_flags,
-               license_flags, db_flags, server_client_flags],
+               license_flags, misconf_flags, db_flags, server_client_flags],
     "repo": [global_flags, scan_flags, report_flags, secret_flags,
-             license_flags, db_flags, server_client_flags],
+             license_flags, misconf_flags, db_flags, server_client_flags],
     "image": [global_flags, scan_flags, report_flags, secret_flags,
-              license_flags, db_flags, server_client_flags],
+              license_flags, misconf_flags, db_flags, server_client_flags],
     "sbom": [global_flags, scan_flags, report_flags, db_flags,
              server_client_flags],
     "convert": [global_flags, report_flags],

@@ -53,6 +53,10 @@ def _artifact_option(ns, opts):
         disabled_analyzers=disabled,
         secret_config_path=secret_cfg,
         backend=device_backend,
+        analyzer_extra={
+            "check_paths": list(opts.get("config_check") or []),
+            "misconfig_scanners": list(opts.get("misconfig_scanners") or []),
+        },
     )
 
 

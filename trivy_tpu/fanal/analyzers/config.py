@@ -29,8 +29,10 @@ class ConfigAnalyzer(BatchAnalyzer):
     def __init__(self, options):
         self._files: list[tuple[str, bytes]] = []
         self._scanner = None
-        self._disabled = list(getattr(options, "extra", {}).get(
-            "disabled_check_ids", []))
+        extra = getattr(options, "extra", {}) or {}
+        self._disabled = list(extra.get("disabled_check_ids", []))
+        self._check_paths = list(extra.get("check_paths", []))
+        self._file_types = list(extra.get("misconfig_scanners", []))
 
     def required(self, file_path: str, info) -> bool:
         if info.size > MAX_CONFIG_BYTES:
@@ -45,7 +47,11 @@ class ConfigAnalyzer(BatchAnalyzer):
 
         if self._scanner is None:
             self._scanner = MisconfScanner(
-                ScannerOption(check_ids_disabled=self._disabled)
+                ScannerOption(
+                    check_ids_disabled=self._disabled,
+                    check_paths=self._check_paths,
+                    file_types=self._file_types,
+                )
             )
         files, self._files = self._files, []
         misconfs = self._scanner.scan_files(files)

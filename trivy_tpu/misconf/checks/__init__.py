@@ -80,6 +80,12 @@ def register_cloud(check: Check) -> Check:
     return check
 
 
+def unregister(check_id: str) -> None:
+    """Remove a check by id (custom-check reload support)."""
+    _registry.pop(check_id, None)
+    _cloud_registry.pop(check_id, None)
+
+
 def cloud_checks() -> list[Check]:
     _load_builtins()
     return sorted(_cloud_registry.values(), key=lambda c: c.id)
@@ -105,6 +111,7 @@ def _load_builtins() -> None:
     global _loaded
     if not _loaded:
         _loaded = True
+        import trivy_tpu.misconf.arm  # noqa: F401  (azure cloud checks)
         import trivy_tpu.misconf.checks.cloud_aws  # noqa: F401
         import trivy_tpu.misconf.checks.docker  # noqa: F401
         import trivy_tpu.misconf.checks.kubernetes  # noqa: F401
@@ -194,6 +201,8 @@ def evaluate_cloud(
     for check in cloud_checks():
         if not enabled(check):
             continue
+        if check.file_types and file_type not in check.file_types:
+            continue  # check routed to other IaC types
         if check.targets and not getattr(state, check.targets, None):
             continue  # no matching resources: check not evaluated (no PASS noise)
         failures = list(check.fn(state))

@@ -1,0 +1,194 @@
+"""User-supplied custom checks — the rego-custom-check replacement.
+
+The reference loads user rego policies from ``--config-check`` paths and
+evaluates them beside the builtin bundle (ref: pkg/iac/rego/scanner.go
+custom-check loading; pkg/misconf/scanner.go check_paths plumbing). Here a
+custom check is a Python file declaring checks with the :func:`check` /
+:func:`cloud_check` decorators; loaded checks join the same registries the
+builtins live in, so disable-lists, namespaces and report rendering treat
+them identically.
+
+A check file looks like::
+
+    @check(id="USR-001", severity="HIGH", types=("yaml",),
+           title="deny latest tags")
+    def no_latest(docs):
+        for doc in docs:
+            tag = str((doc or {}).get("image", ""))
+            if tag.endswith(":latest"):
+                yield Failure("image uses :latest", start_line=doc.line("image"))
+
+``types`` routes the check: dockerfile/kubernetes checks receive the same
+parsed inputs the builtins do; yaml/json checks receive the line-tracking
+document list. ``cloud_check(targets=...)`` registers a typed-state check
+(terraform + cloudformation + azure-arm states).
+"""
+
+from __future__ import annotations
+
+import os
+
+from trivy_tpu import log
+from trivy_tpu.misconf.checks import (
+    Check,
+    CloudFailure,
+    Failure,
+    register,
+    register_cloud,
+    unregister,
+)
+
+logger = log.logger("misconf:custom")
+
+# (realpath, content-hash) of loaded files: re-loading an unchanged file is
+# a no-op; a rewritten file re-registers its checks
+_loaded_files: set[tuple[str, str]] = set()
+# id → source path for custom checks: same-file reload replaces silently,
+# a second file claiming an existing id replaces with a warning; colliding
+# with a builtin still errors
+_custom_ids: dict[str, str] = {}
+
+
+class CustomCheckError(ValueError):
+    pass
+
+
+def _replace_existing(check_id: str, source_path: str) -> None:
+    prev = _custom_ids.get(check_id)
+    if prev is None:
+        return  # not custom: builtin collision errors inside register()
+    if os.path.realpath(prev) != os.path.realpath(source_path):
+        logger.warning(
+            "custom check %s from %s replaces the one from %s",
+            check_id, source_path, prev,
+        )
+    unregister(check_id)
+
+
+def _make_namespace(source_path: str) -> dict:
+    registered: list[str] = []
+
+    def check(
+        id: str,
+        severity: str,
+        title: str,
+        types=("yaml", "json"),
+        description: str = "",
+        resolution: str = "",
+        url: str = "",
+        service: str = "custom",
+        provider: str = "",
+    ):
+        def wrap(fn):
+            _replace_existing(id, source_path)
+            register(
+                Check(
+                    id=id,
+                    avd_id=id,
+                    title=title,
+                    severity=severity.upper(),
+                    file_types=tuple(types),
+                    fn=fn,
+                    description=description,
+                    resolution=resolution,
+                    url=url,
+                    service=service,
+                    provider=provider,
+                )
+            )
+            _custom_ids[id] = source_path
+            registered.append(id)
+            return fn
+
+        return wrap
+
+    def cloud_check(
+        id: str,
+        severity: str,
+        title: str,
+        targets: str,
+        types=("terraform", "cloudformation"),
+        description: str = "",
+        resolution: str = "",
+        url: str = "",
+        service: str = "custom",
+        provider: str = "",
+    ):
+        def wrap(fn):
+            _replace_existing(id, source_path)
+            register_cloud(
+                Check(
+                    id=id,
+                    avd_id=id,
+                    title=title,
+                    severity=severity.upper(),
+                    file_types=tuple(types),
+                    fn=fn,
+                    description=description,
+                    resolution=resolution,
+                    url=url,
+                    service=service,
+                    provider=provider,
+                    targets=targets,
+                )
+            )
+            _custom_ids[id] = source_path
+            registered.append(id)
+            return fn
+
+        return wrap
+
+    return {
+        "check": check,
+        "cloud_check": cloud_check,
+        "Failure": Failure,
+        "CloudFailure": CloudFailure,
+        "__file__": source_path,
+        "__name__": f"trivy_custom_check:{os.path.basename(source_path)}",
+        "_registered": registered,
+    }
+
+
+def _load_file(path: str) -> int:
+    import hashlib
+
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    key = (os.path.realpath(path), hashlib.sha256(source.encode()).hexdigest())
+    if key in _loaded_files:
+        return 0
+    ns = _make_namespace(path)
+    try:
+        code = compile(source, path, "exec")
+        exec(code, ns)  # noqa: S102 — explicit user-supplied check file
+    except CustomCheckError:
+        raise
+    except Exception as e:
+        raise CustomCheckError(f"custom check file {path} failed to load: {e}") from e
+    _loaded_files.add(key)
+    n = len(ns["_registered"])
+    logger.debug("loaded %d custom checks from %s", n, path)
+    return n
+
+
+def load_custom_checks(paths: list[str]) -> int:
+    """Load all ``*.py`` check files from the given files/dirs; returns the
+    number of newly registered checks."""
+    # builtins first so collisions with builtin ids fail loudly here
+    from trivy_tpu.misconf import checks as _checks
+
+    _checks.all_checks()
+    total = 0
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        total += _load_file(os.path.join(root, name))
+        elif p.endswith(".py"):
+            total += _load_file(p)
+        else:
+            raise CustomCheckError(
+                f"custom check path {p} is neither a directory nor a .py file"
+            )
+    return total

@@ -66,10 +66,16 @@ def is_helm(path: str, content: bytes) -> bool:
 
 
 def _load_yaml_docs(content: bytes):
+    """Tag-tolerant load (CFN ``!Ref``/``!Sub`` short forms, vendor tags) —
+    shares the loader with parse.yamljson so detection and parsing agree."""
     import yaml
 
+    from trivy_tpu.misconf.parse.yamljson import tolerant_loader_cls
+
     try:
-        return list(yaml.safe_load_all(content.decode("utf-8", "replace")))
+        return list(
+            yaml.load_all(content.decode("utf-8", "replace"), Loader=tolerant_loader_cls())
+        )
     except Exception:
         return None
 

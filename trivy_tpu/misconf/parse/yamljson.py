@@ -73,11 +73,36 @@ def _construct(node, loader):
     return loader.construct_object(node, deep=True)
 
 
+_tolerant_cls = None
+
+
+def tolerant_loader_cls():
+    """SafeLoader subclass mapping unknown tags (``!reference``, vendor
+    extensions) to their plain node values — detection and parsing must
+    agree on which files are loadable."""
+    global _tolerant_cls
+    if _tolerant_cls is None:
+
+        class Loader(yaml.SafeLoader):
+            pass
+
+        def _any(loader, tag_suffix, node):
+            if isinstance(node, yaml.ScalarNode):
+                return loader.construct_scalar(node)
+            if isinstance(node, yaml.SequenceNode):
+                return loader.construct_sequence(node)
+            return loader.construct_mapping(node)
+
+        Loader.add_multi_constructor("!", _any)
+        _tolerant_cls = Loader
+    return _tolerant_cls
+
+
 def load_all(content: bytes) -> list:
     """All YAML documents with line spans; raises on malformed input."""
     text = content.decode("utf-8", "replace")
     docs = []
-    loader = yaml.SafeLoader(text)
+    loader = tolerant_loader_cls()(text)
     try:
         while loader.check_node():
             node = loader.get_node()

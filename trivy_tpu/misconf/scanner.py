@@ -48,6 +48,7 @@ class ScannerOption:
     include_non_failures: bool = False
     check_ids_disabled: list[str] = field(default_factory=list)
     check_paths: list[str] = field(default_factory=list)  # custom check files/dirs
+    file_types: list[str] = field(default_factory=list)  # limit scanned types
 
 
 class MisconfScanner:
@@ -73,6 +74,8 @@ class MisconfScanner:
                 logger.debug("misconf type detection failed for %s: %s", path, e)
                 continue
             if ftype is None:
+                continue
+            if self.option.file_types and ftype not in self.option.file_types:
                 continue
             if ftype == detection.FILE_TYPE_TERRAFORM:
                 tf_files[path] = content
