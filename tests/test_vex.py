@@ -1,0 +1,247 @@
+"""VEX filtering + ignore-policy tests (ref: pkg/vex/vex_test.go,
+pkg/result/filter_test.go policy cases)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from trivy_tpu import vex
+from trivy_tpu.result import FilterOptions, IgnorePolicy, PolicyError, filter_report
+from trivy_tpu.types import (
+    DetectedVulnerability,
+    PkgIdentifier,
+    Report,
+    Result,
+    SecretFinding,
+)
+
+
+def _vuln(vid="CVE-2024-0001", name="liba", version="1.2.3",
+          purl="pkg:pypi/liba@1.2.3", severity="HIGH"):
+    return DetectedVulnerability(
+        vulnerability_id=vid,
+        pkg_name=name,
+        installed_version=version,
+        pkg_identifier=PkgIdentifier(purl=purl, uid="u1"),
+        severity=severity,
+    )
+
+
+def _report(*vulns) -> Report:
+    return Report(
+        artifact_name="test",
+        results=[Result(target="requirements.txt", cls="lang-pkgs",
+                        type="pip", vulnerabilities=list(vulns))],
+    )
+
+
+OPENVEX = {
+    "@context": "https://openvex.dev/ns/v0.2.0",
+    "@id": "https://example.com/vex-1",
+    "statements": [
+        {
+            "vulnerability": {"name": "CVE-2024-0001"},
+            "products": [{"@id": "pkg:pypi/liba@1.2.3"}],
+            "status": "not_affected",
+            "justification": "vulnerable_code_not_present",
+        }
+    ],
+}
+
+
+class TestPurlMatch:
+    def test_exact(self):
+        assert vex.purl_matches("pkg:pypi/liba@1.2.3", "pkg:pypi/liba@1.2.3")
+
+    def test_versionless_vex_matches_any_version(self):
+        assert vex.purl_matches("pkg:pypi/liba", "pkg:pypi/liba@1.2.3")
+
+    def test_version_mismatch(self):
+        assert not vex.purl_matches("pkg:pypi/liba@2.0.0", "pkg:pypi/liba@1.2.3")
+
+    def test_type_mismatch(self):
+        assert not vex.purl_matches("pkg:npm/liba@1.2.3", "pkg:pypi/liba@1.2.3")
+
+    def test_namespace_and_qualifiers(self):
+        assert vex.purl_matches(
+            "pkg:deb/debian/bash", "pkg:deb/debian/bash@5.1?arch=amd64"
+        )
+        assert not vex.purl_matches(
+            "pkg:deb/debian/bash?arch=arm64", "pkg:deb/debian/bash@5.1?arch=amd64"
+        )
+
+
+class TestOpenVEX:
+    def test_not_affected_suppressed(self, tmp_path):
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(OPENVEX))
+        report = _report(_vuln(), _vuln(vid="CVE-2024-9999"))
+        vex.filter_report(report, [str(p)])
+        res = report.results[0]
+        assert [v.vulnerability_id for v in res.vulnerabilities] == ["CVE-2024-9999"]
+        assert len(res.modified_findings) == 1
+        mf = res.modified_findings[0]
+        assert mf.status == "not_affected"
+        assert mf.finding["VulnerabilityID"] == "CVE-2024-0001"
+
+    def test_affected_status_kept(self, tmp_path):
+        doc = dict(OPENVEX)
+        doc["statements"] = [dict(OPENVEX["statements"][0], status="affected")]
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert len(report.results[0].vulnerabilities) == 1
+
+    def test_last_statement_wins(self, tmp_path):
+        doc = dict(OPENVEX)
+        doc["statements"] = [
+            dict(OPENVEX["statements"][0], status="not_affected"),
+            dict(OPENVEX["statements"][0], status="affected"),
+        ]
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert len(report.results[0].vulnerabilities) == 1
+
+
+class TestCycloneDXVEX:
+    def test_bom_ref_resolution(self, tmp_path):
+        doc = {
+            "bomFormat": "CycloneDX",
+            "specVersion": "1.5",
+            "components": [
+                {"bom-ref": "ref-liba", "name": "liba", "purl": "pkg:pypi/liba@1.2.3"}
+            ],
+            "vulnerabilities": [
+                {
+                    "id": "CVE-2024-0001",
+                    "analysis": {"state": "not_affected", "detail": "sandboxed"},
+                    "affects": [{"ref": "ref-liba"}],
+                }
+            ],
+        }
+        p = tmp_path / "bom.vex.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert not report.results[0].vulnerabilities
+        assert report.results[0].modified_findings[0].statement == "sandboxed"
+
+    def test_resolved_maps_to_fixed(self, tmp_path):
+        doc = {
+            "bomFormat": "CycloneDX",
+            "vulnerabilities": [
+                {
+                    "id": "CVE-2024-0001",
+                    "analysis": {"state": "resolved"},
+                    "affects": [{"ref": "pkg:pypi/liba@1.2.3"}],
+                }
+            ],
+            "components": [],
+        }
+        p = tmp_path / "bom.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert report.results[0].modified_findings[0].status == "fixed"
+
+
+class TestCSAF:
+    def test_known_not_affected(self, tmp_path):
+        doc = {
+            "document": {"category": "csaf_vex"},
+            "product_tree": {
+                "branches": [
+                    {
+                        "product": {
+                            "product_id": "LIBA",
+                            "product_identification_helper": {
+                                "purl": "pkg:pypi/liba@1.2.3"
+                            },
+                        }
+                    }
+                ]
+            },
+            "vulnerabilities": [
+                {"cve": "CVE-2024-0001", "product_status": {"known_not_affected": ["LIBA"]}}
+            ],
+        }
+        p = tmp_path / "csaf.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert not report.results[0].vulnerabilities
+        assert report.results[0].modified_findings[0].source == "csaf.json"
+
+
+class TestIgnorePolicy:
+    def test_policy_filters_vulns(self, tmp_path):
+        p = tmp_path / "policy.py"
+        p.write_text(
+            "def ignore_vulnerability(v):\n"
+            "    return v['Severity'] == 'LOW'\n"
+        )
+        report = _report(_vuln(severity="LOW"), _vuln(vid="CVE-2024-2", severity="HIGH"))
+        filter_report(report, FilterOptions(policy_file=str(p)))
+        res = report.results[0]
+        assert [v.vulnerability_id for v in res.vulnerabilities] == ["CVE-2024-2"]
+        assert res.modified_findings[0].status == "ignored"
+
+    def test_generic_predicate(self, tmp_path):
+        p = tmp_path / "policy.py"
+        p.write_text(
+            "def ignore(finding, kind):\n"
+            "    return kind == 'secret'\n"
+        )
+        report = Report(results=[Result(
+            target="x",
+            secrets=[SecretFinding(rule_id="r", category="c", severity="HIGH",
+                                   title="t", start_line=1, end_line=1,
+                                   match="x")],
+        )])
+        filter_report(
+            report, FilterOptions(policy_file=str(p), show_suppressed=True)
+        )
+        assert not report.results[0].secrets
+        assert report.results[0].modified_findings[0].type == "secret"
+
+    def test_empty_policy_rejected(self, tmp_path):
+        p = tmp_path / "policy.py"
+        p.write_text("x = 1\n")
+        with pytest.raises(PolicyError):
+            IgnorePolicy(str(p))
+
+    def test_vex_through_filter_report(self, tmp_path):
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(OPENVEX))
+        report = _report(_vuln())
+        filter_report(
+            report, FilterOptions(vex_sources=[str(p)], show_suppressed=True)
+        )
+        # result kept for its modified findings; vuln suppressed
+        assert report.results
+        assert not report.results[0].vulnerabilities
+
+    def test_suppressed_only_result_dropped_by_default(self, tmp_path):
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(OPENVEX))
+        report = _report(_vuln())
+        filter_report(report, FilterOptions(vex_sources=[str(p)]))
+        assert report.results == []
+
+    def test_ignorefile_records_suppression(self, tmp_path):
+        ign = tmp_path / ".trivyignore"
+        ign.write_text("CVE-2024-0001\n")
+        report = _report(_vuln(), _vuln(vid="CVE-2024-2"))
+        filter_report(
+            report,
+            FilterOptions(ignore_file=str(ign), show_suppressed=True),
+        )
+        res = report.results[0]
+        assert [v.vulnerability_id for v in res.vulnerabilities] == ["CVE-2024-2"]
+        assert res.modified_findings[0].status == "ignored"
+        assert res.modified_findings[0].source == str(ign)

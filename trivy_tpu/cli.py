@@ -73,7 +73,13 @@ def report_flags() -> FlagGroup:
             Flag("ignorefile", default=".trivyignore", config_name="ignorefile",
                  help="ignore file path"),
             Flag("ignore-policy", default=None, config_name="ignore-policy",
-                 help="filter findings with a policy file"),
+                 help="suppress findings with a Python predicate file "
+                      "(ignore_vulnerability/ignore_secret/... or ignore())"),
+            Flag("vex", default=[], is_list=True, config_name="vex",
+                 help="VEX document paths (OpenVEX / CycloneDX VEX / CSAF)"),
+            Flag("show-suppressed", default=False, value_type=bool,
+                 config_name="show-suppressed",
+                 help="list VEX/policy-suppressed findings in table output"),
             Flag("template", default=None, short="t", config_name="template",
                  help="go-template style output template (for --format template)"),
             Flag("list-all-pkgs", default=False, value_type=bool,

@@ -97,7 +97,13 @@ def run(command: str, ns, opts) -> int:
     if timeout > 0 and command != "server":
         signal.signal(signal.SIGALRM, on_timeout)
         signal.alarm(timeout)
+    from trivy_tpu.result import IgnorePolicy, PolicyError
+
     try:
+        # validate the ignore policy up front: a broken policy file must not
+        # cost the user a full scan before failing
+        if opts.get("ignore_policy"):
+            IgnorePolicy(opts["ignore_policy"])
         if command in ("fs", "rootfs", "repo"):
             return _run_fs_like(command, ns, opts)
         if command == "image":
@@ -114,6 +120,9 @@ def run(command: str, ns, opts) -> int:
     except TimeoutError as e:
         logger.error("%s", e)
         return 1
+    except PolicyError as e:
+        logger.error("%s", e)
+        return 2
     except ModuleNotFoundError as e:
         if (e.name or "").startswith("trivy_tpu"):
             logger.error(
@@ -135,12 +144,17 @@ def _emit(report, ns, opts) -> int:
         FilterOptions(
             severities=opts.get("severity") or [],
             ignore_file=opts.get("ignorefile"),
+            vex_sources=opts.get("vex") or [],
+            policy_file=opts.get("ignore_policy"),
+            show_suppressed=bool(opts.get("show_suppressed")),
         ),
     )
     output = opts.get("output")
     kw = {}
     if opts.get("template"):
         kw["template"] = opts["template"]
+    if opts.get("show_suppressed"):
+        kw["show_suppressed"] = True
     if output:
         with open(output, "w") as f:
             report_pkg.write(report, opts.get("format", "table"), f, **kw)

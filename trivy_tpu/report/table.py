@@ -23,13 +23,38 @@ def _severity_summary(counter: Counter) -> str:
     return ", ".join(parts) if parts else "none"
 
 
-def write_table(report: Report, out, **_kw) -> None:
-    if not report.results:
+def write_table(report: Report, out, show_suppressed: bool = False, **_kw) -> None:
+    visible = any(not r.is_empty for r in report.results)
+    n_suppressed = sum(len(r.modified_findings) for r in report.results)
+    if not visible:
         out.write(f"\n{report.artifact_name} ({report.artifact_type})\n")
         out.write("No issues detected.\n")
-        return
+        if n_suppressed and not show_suppressed:
+            out.write(
+                f"({n_suppressed} suppressed finding"
+                f"{'s' if n_suppressed != 1 else ''}; --show-suppressed lists them)\n"
+            )
     for result in report.results:
         _write_result(result, out)
+        if show_suppressed and result.modified_findings:
+            _write_suppressed(result, out)
+
+
+def _write_suppressed(result: Result, out) -> None:
+    """Suppressed-findings table (ref: pkg/report/table --show-suppressed)."""
+    _header(out, f"{result.target} (suppressed)",
+            f"— {len(result.modified_findings)} findings")
+    cols = ["ID", "Type", "Status", "Statement", "Source"]
+    rows = []
+    for m in result.modified_findings:
+        fid = (
+            m.finding.get("VulnerabilityID")
+            or m.finding.get("ID")
+            or m.finding.get("RuleID")
+            or m.finding.get("Name", "")
+        )
+        rows.append([fid, m.type, m.status, (m.statement or "")[:50], m.source])
+    _grid(out, cols, rows)
 
 
 def _header(out, title: str, extra: str = "") -> None:

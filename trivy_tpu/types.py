@@ -786,6 +786,37 @@ class DetectedLicense:
 
 
 @dataclass
+class ModifiedFinding:
+    """A finding suppressed or altered post-scan, e.g. by a VEX statement or
+    an ignore policy (ref: pkg/types/finding.go ModifiedFinding)."""
+
+    type: str = "vulnerability"
+    status: str = ""  # not_affected | fixed | ignored | under_investigation
+    statement: str = ""
+    source: str = ""
+    finding: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "Type": self.type,
+            "Status": self.status,
+            "Statement": self.statement,
+            "Source": self.source,
+            "Finding": dict(self.finding),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ModifiedFinding":
+        return cls(
+            type=d.get("Type", "vulnerability"),
+            status=d.get("Status", ""),
+            statement=d.get("Statement", ""),
+            source=d.get("Source", ""),
+            finding=d.get("Finding", {}) or {},
+        )
+
+
+@dataclass
 class Result:
     """One report section: findings of one class for one target (ref: types.Result)."""
 
@@ -797,6 +828,7 @@ class Result:
     misconfigurations: list[MisconfResult] = field(default_factory=list)
     secrets: list[SecretFinding] = field(default_factory=list)
     licenses: list[DetectedLicense] = field(default_factory=list)
+    modified_findings: list[ModifiedFinding] = field(default_factory=list)
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {"Target": self.target, "Class": self.cls, "Type": self.type}
@@ -810,6 +842,10 @@ class Result:
             d["Secrets"] = [s.to_dict() for s in self.secrets]
         if self.licenses:
             d["Licenses"] = [l.to_dict() for l in self.licenses]
+        if self.modified_findings:
+            d["ExperimentalModifiedFindings"] = [
+                m.to_dict() for m in self.modified_findings
+            ]
         return d
 
     @classmethod
@@ -827,6 +863,10 @@ class Result:
             ],
             secrets=[SecretFinding.from_dict(x) for x in d.get("Secrets", []) or []],
             licenses=[DetectedLicense.from_dict(x) for x in d.get("Licenses", []) or []],
+            modified_findings=[
+                ModifiedFinding.from_dict(x)
+                for x in d.get("ExperimentalModifiedFindings", []) or []
+            ],
         )
 
     @property
