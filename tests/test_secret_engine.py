@@ -234,3 +234,77 @@ def test_blob_roundtrip():
     # offset is a working field dropped on serialization (the reference also
     # deletes it from output), so compare the serialized forms.
     assert back.to_dict() == d
+
+
+def test_reference_rule_id_parity():
+    """Every reference builtin rule ID exists here (the 87 IDs are the
+    suppression/reporting interface; ref: pkg/fanal/secret/builtin-rules.go).
+    This build carries additional rules beyond the reference set."""
+    # the 87 IDs from the reference, grouped as they appear there
+    reference_ids = """
+    aws-access-key-id aws-secret-access-key github-pat github-oauth
+    github-app-token github-refresh-token github-fine-grained-pat
+    gitlab-pat facebook-token hugging-face-access-token private-key
+    shopify-token slack-access-token slack-web-hook stripe-publishable-token
+    stripe-secret-token pypi-upload-token gcp-service-account
+    heroku-api-key twilio-api-key adobe-client-id adobe-client-secret
+    age-secret-key alibaba-access-key-id alibaba-secret-key asana-client-id
+    asana-client-secret atlassian-api-token bitbucket-client-id
+    bitbucket-client-secret beamer-api-token clojars-api-token
+    contentful-delivery-api-token databricks-api-token discord-api-token
+    discord-client-id discord-client-secret doppler-api-token
+    dockerconfig-secret dropbox-api-secret dropbox-short-lived-api-token
+    dropbox-long-lived-api-token duffel-api-token dynatrace-api-token
+    easypost-api-token fastly-api-token finicity-client-secret
+    finicity-api-token flutterwave-public-key flutterwave-enc-key
+    frameio-api-token gocardless-api-token grafana-api-token
+    hashicorp-tf-api-token hubspot-api-token intercom-api-token
+    intercom-client-secret ionic-api-token jwt-token linear-api-token
+    linear-client-secret lob-api-key lob-pub-api-key linkedin-client-id
+    linkedin-client-secret mailchimp-api-key mailgun-token
+    mailgun-signing-key mapbox-api-token messagebird-api-token
+    messagebird-client-id new-relic-user-api-key new-relic-user-api-id
+    new-relic-browser-api-token npm-access-token planetscale-password
+    planetscale-api-token postman-api-token private-packagist-token
+    pulumi-api-token rubygems-api-token sendgrid-api-token
+    sendinblue-api-token shippo-api-token twitch-api-token twitter-token
+    typeform-api-token
+    """.split()
+    assert len(reference_ids) == 87
+    ours = {r.id for r in builtin_rules()}
+    missing = sorted(set(reference_ids) - ours)
+    assert not missing, f"reference rule IDs missing: {missing}"
+    assert len(ours) >= 87
+
+
+def test_device_lane_coverage():
+    """Lane accounting: every rule lands in the anchored or keyword device
+    lane (no rule forces a host-side scan of every file), and the anchored
+    lane covers the majority of distinct-prefix token rules."""
+    from trivy_tpu.secret.device_compile import compile_rule
+
+    rules = builtin_rules()
+    anchored = [r.id for r in rules if compile_rule(r)]
+    keyworded = [r.id for r in rules if not compile_rule(r) and r.keywords]
+    host_only = [r.id for r in rules if not compile_rule(r) and not r.keywords]
+    assert not host_only, f"rules with no device lane: {host_only}"
+    assert len(anchored) >= 60
+    assert len(anchored) + len(keyworded) == len(rules)
+
+
+def test_end_anchored_rule_window_parity():
+    """An end-anchored guard ('(?:[^X]|$)') must not match at a window edge
+    that isn't the real end of content: finditer's endpos acts as $, so such
+    rules take the full-scan path (engine fallback on has_end_anchor)."""
+    from trivy_tpu.secret.engine import SecretScanner as Engine
+
+    rules = {r.id: r for r in builtin_rules()}
+    rule = rules["discord-client-id"]
+    assert rule.has_end_anchor
+    content = 'discord_id = "' + "9" * 2000 + '"'  # 2000 digits: no match
+    eng = Engine()
+    full = eng.find_rule_locations(rule, content, content.lower(), [])
+    windowed = eng.find_rule_locations_in_windows(
+        rule, content, content.lower(), [], [(0, 128)]
+    )
+    assert full == windowed == []

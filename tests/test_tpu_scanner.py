@@ -89,7 +89,7 @@ def test_parity_with_custom_rules():
                     "keywords": ["cmp_"],
                 },
             ],
-            "disable-rules": ["mailgun-api-key"],
+            "disable-rules": ["mailgun-token"],
         }
     )
     cpu = SecretScanner(cfg)
@@ -106,7 +106,7 @@ def test_parity_with_custom_rules():
 
 
 def test_secret_at_exact_chunk_boundaries(cpu, tpu):
-    sample = SAMPLES["slack-bot-token"]
+    sample = SAMPLES["slack-access-token"]
     step = tpu.chunk_len - tpu.overlap
     files = []
     for pos in [step - len(sample), step - 10, step - 1, step, step + 1, 2 * step - 5]:
@@ -114,7 +114,7 @@ def test_secret_at_exact_chunk_boundaries(cpu, tpu):
         files.append((f"bound_{pos}.txt", data))
     assert_parity(cpu, tpu, files)
     for s in tpu.scan_files(files):
-        assert any(f.rule_id == "slack-bot-token" for f in s.findings), s.file_path
+        assert any(f.rule_id == "slack-access-token" for f in s.findings), s.file_path
 
 
 def test_parity_latin1_space_and_dotall_custom_rules():

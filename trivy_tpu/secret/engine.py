@@ -236,9 +236,10 @@ class SecretScanner:
         if not rule.match_keywords(lower):  # keywords are a whole-file test
             return []
         wmax = rule.max_match_width
-        if wmax is None or wmax > 8192 or rule.has_lookaround:
-            # lookarounds examine context beyond getwidth()'s bound, so the
-            # fixed padding below cannot guarantee parity — full scan instead
+        if wmax is None or wmax > 8192 or rule.has_lookaround or rule.has_end_anchor:
+            # lookarounds examine context beyond getwidth()'s bound, and end
+            # anchors ($/\Z) match at finditer's endpos even mid-content, so
+            # the fixed padding below cannot guarantee parity — full scan
             return self.find_rule_locations(rule, content, lower, global_blocks)
         n = len(content)
         # slack beyond the match width for anchor/word-prefix context; rules

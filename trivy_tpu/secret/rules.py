@@ -39,6 +39,21 @@ def ws(pattern: str) -> str:
     return f"{_WORD_PREFIX}(?P<{SECRET_GROUP}>{pattern})"
 
 
+def kw(name: str, secret: str, guard: str | None = None) -> str:
+    """Keyword-context rule: ``name`` within ~25 chars of an assignment
+    operator, payload captured in the ``secret`` group. ``guard`` is a
+    character-class body asserting the payload is not a prefix of a longer
+    run (compiles to ``(?:[^guard]|$)`` — the end alternative makes the
+    rule end-anchored, so the engine gives it the full-content scan path).
+    One definition for all keyword-context rules so the window/guard shape
+    has a single audit point."""
+    g = f"(?:[^{guard}]|$)" if guard else ""
+    return (
+        rf"(?i){name}[a-z0-9_\-\s\"']{{0,25}}[=:][\s\"']{{0,5}}"
+        rf"(?P<{SECRET_GROUP}>{secret}){g}"
+    )
+
+
 @dataclass
 class AllowRule:
     """Suppression rule (ref: pkg/fanal/secret/builtin-allow-rules.go).
@@ -113,6 +128,38 @@ class Rule:
                 for op, av in items:
                     name = str(op)
                     if name in ("ASSERT", "ASSERT_NOT"):
+                        return True
+                    if isinstance(av, tuple):
+                        for part in av:
+                            if isinstance(part, sre_parse.SubPattern) and walk(part):
+                                return True
+                            if isinstance(part, (list, tuple)):
+                                for sub in part:
+                                    if isinstance(sub, sre_parse.SubPattern) and walk(sub):
+                                        return True
+                    elif isinstance(av, sre_parse.SubPattern) and walk(av):
+                        return True
+                return False
+
+            return walk(sre_parse.parse(self.regex))
+        except Exception:
+            return True
+
+    @cached_property
+    def has_end_anchor(self) -> bool:
+        """True when the pattern can match ``$``/``\\Z``. ``finditer(pos,
+        endpos)`` treats endpos as end-of-string, so an end anchor matches at
+        a window edge where the full scan (with real trailing content) would
+        not — such rules must take the full-content path for parity."""
+        try:
+            import re._constants as sre_c
+            import re._parser as sre_parse
+
+            def walk(items) -> bool:
+                for op, av in items:
+                    if op is sre_c.AT and av in (
+                        sre_c.AT_END, sre_c.AT_END_STRING, sre_c.AT_END_LINE
+                    ):
                         return True
                     if isinstance(av, tuple):
                         for part in av:
@@ -219,7 +266,7 @@ def builtin_rules() -> list[Rule]:
         # ----- VCS / forges ----------------------------------------------------
         _r("github-pat", CategoryGitHub, "GitHub personal access token", S.CRITICAL,
            ws(r"ghp_[0-9a-zA-Z]{36}"), ["ghp_"], secret_group_name=SECRET_GROUP),
-        _r("github-oauth-token", CategoryGitHub, "GitHub OAuth access token", S.CRITICAL,
+        _r("github-oauth", CategoryGitHub, "GitHub OAuth access token", S.CRITICAL,
            ws(r"gho_[0-9a-zA-Z]{36}"), ["gho_"], secret_group_name=SECRET_GROUP),
         _r("github-app-token", CategoryGitHub, "GitHub app token", S.CRITICAL,
            ws(r"(?:ghu|ghs)_[0-9a-zA-Z]{36}"), ["ghu_", "ghs_"], secret_group_name=SECRET_GROUP),
@@ -246,16 +293,13 @@ def builtin_rules() -> list[Rule]:
            ws(r"ey[a-zA-Z0-9_=]{14,}\.ey[a-zA-Z0-9_/+\-=]{14,}\.[a-zA-Z0-9_/+\-=]{10,}"),
            ["eyJ"], secret_group_name=SECRET_GROUP),
         # ----- chat / collaboration -------------------------------------------
-        _r("slack-bot-token", CategorySlack, "Slack bot token", S.HIGH,
-           ws(r"xoxb-[0-9]{8,14}-[0-9]{8,14}-[0-9a-zA-Z]{18,32}"), ["xoxb-"],
-           secret_group_name=SECRET_GROUP),
-        _r("slack-user-token", CategorySlack, "Slack user token", S.HIGH,
-           ws(r"xox[ps]-[0-9]{8,14}-[0-9]{8,14}-[0-9]{8,14}-[0-9a-f]{28,34}"),
-           ["xoxp-", "xoxs-"], secret_group_name=SECRET_GROUP),
+        _r("slack-access-token", CategorySlack, "Slack token", S.HIGH,
+           ws(r"xox[baprs]-(?:[0-9]{8,14}-){2,3}[0-9a-zA-Z]{18,34}"), ["xoxb-",
+           "xoxa-", "xoxp-", "xoxr-", "xoxs-"], secret_group_name=SECRET_GROUP),
         _r("slack-app-token", CategorySlack, "Slack app-level token", S.HIGH,
            ws(r"xapp-[0-9]-[0-9A-Z]{8,12}-[0-9]{10,14}-[0-9a-f]{60,70}"), ["xapp-"],
            secret_group_name=SECRET_GROUP),
-        _r("slack-webhook-url", CategorySlack, "Slack incoming webhook URL", S.MEDIUM,
+        _r("slack-web-hook", CategorySlack, "Slack incoming webhook URL", S.MEDIUM,
            r"https://hooks\.slack\.com/(?:services|workflows)/"
            r"[0-9A-Z]{8,12}/[0-9A-Z]{8,12}/[0-9a-zA-Z]{20,26}",
            ["hooks.slack.com"]),
@@ -268,10 +312,10 @@ def builtin_rules() -> list[Rule]:
            r"(?P<secret>[0-9]{8,10}:[0-9A-Za-z_\-]{35})",
            ["telegram"], secret_group_name=SECRET_GROUP),
         # ----- payments --------------------------------------------------------
-        _r("stripe-secret-key", CategoryStripe, "Stripe secret key", S.CRITICAL,
+        _r("stripe-secret-token", CategoryStripe, "Stripe secret key", S.CRITICAL,
            ws(r"sk_(?:test|live)_[0-9a-zA-Z]{24,99}"), ["sk_test_", "sk_live_"],
            secret_group_name=SECRET_GROUP),
-        _r("stripe-publishable-key", CategoryStripe, "Stripe publishable key", S.LOW,
+        _r("stripe-publishable-token", CategoryStripe, "Stripe publishable key", S.LOW,
            ws(r"pk_(?:test|live)_[0-9a-zA-Z]{24,99}"), ["pk_test_", "pk_live_"],
            secret_group_name=SECRET_GROUP),
         _r("square-access-token", "Square", "Square access token", S.HIGH,
@@ -281,19 +325,14 @@ def builtin_rules() -> list[Rule]:
         _r("paypal-braintree-token", "PayPal", "Braintree access token", S.HIGH,
            ws(r"access_token\$production\$[0-9a-z]{16}\$[0-9a-f]{32}"),
            ["access_token$production$"], secret_group_name=SECRET_GROUP),
-        _r("shopify-access-token", CategoryShopify, "Shopify access token", S.CRITICAL,
-           ws(r"shpat_[0-9a-fA-F]{32}"), ["shpat_"], secret_group_name=SECRET_GROUP),
-        _r("shopify-custom-app-token", CategoryShopify, "Shopify custom app access token", S.CRITICAL,
-           ws(r"shpca_[0-9a-fA-F]{32}"), ["shpca_"], secret_group_name=SECRET_GROUP),
-        _r("shopify-private-app-token", CategoryShopify, "Shopify private app access token",
-           S.CRITICAL, ws(r"shppa_[0-9a-fA-F]{32}"), ["shppa_"], secret_group_name=SECRET_GROUP),
-        _r("shopify-shared-secret", CategoryShopify, "Shopify shared secret", S.HIGH,
-           ws(r"shpss_[0-9a-fA-F]{32}"), ["shpss_"], secret_group_name=SECRET_GROUP),
+        _r("shopify-token", CategoryShopify, "Shopify token", S.CRITICAL,
+           ws(r"shp(?:at|ca|pa|ss)_[0-9a-fA-F]{32}"),
+           ["shpat_", "shpca_", "shppa_", "shpss_"], secret_group_name=SECRET_GROUP),
         # ----- email / messaging SaaS -----------------------------------------
-        _r("sendgrid-api-key", "SendGrid", "SendGrid API key", S.HIGH,
+        _r("sendgrid-api-token", "SendGrid", "SendGrid API key", S.HIGH,
            ws(r"SG\.[0-9A-Za-z_\-]{22}\.[0-9A-Za-z_\-]{43}"), ["SG."],
            secret_group_name=SECRET_GROUP),
-        _r("mailgun-api-key", "Mailgun", "Mailgun API key", S.HIGH,
+        _r("mailgun-token", "Mailgun", "Mailgun private API token", S.HIGH,
            ws(r"key-[0-9a-f]{32}"), ["key-"], secret_group_name=SECRET_GROUP),
         _r("mailchimp-api-key", "Mailchimp", "Mailchimp API key", S.HIGH,
            ws(r"[0-9a-f]{32}-us[0-9]{1,2}"), ["-us"], secret_group_name=SECRET_GROUP),
@@ -305,12 +344,12 @@ def builtin_rules() -> list[Rule]:
            ws(r"npm_[0-9a-zA-Z]{36}"), ["npm_"], secret_group_name=SECRET_GROUP),
         _r("pypi-upload-token", "PyPI", "PyPI upload token", S.HIGH,
            r"pypi-AgEIcHlwaS5vcmc[0-9A-Za-z_\-]{50,1000}", ["pypi-AgEIcHlwaS5vcmc"]),
-        _r("rubygems-api-key", "RubyGems", "RubyGems API key", S.HIGH,
+        _r("rubygems-api-token", "RubyGems", "RubyGems API key", S.HIGH,
            ws(r"rubygems_[0-9a-f]{48}"), ["rubygems_"], secret_group_name=SECRET_GROUP),
-        _r("clojars-deploy-token", "Clojars", "Clojars deploy token", S.HIGH,
+        _r("clojars-api-token", "Clojars", "Clojars API token", S.HIGH,
            r"CLOJARS_[0-9a-z]{60}", ["CLOJARS_"]),
         # ----- CI / infra SaaS -------------------------------------------------
-        _r("databricks-token", "Databricks", "Databricks API token", S.HIGH,
+        _r("databricks-api-token", "Databricks", "Databricks API token", S.HIGH,
            ws(r"dapi[0-9a-h]{32}"), ["dapi"], secret_group_name=SECRET_GROUP),
         _r("hashicorp-tf-api-token", "HashiCorp", "Terraform Cloud / Vault API token", S.HIGH,
            ws(r"[0-9a-zA-Z]{14}\.atlasv1\.[0-9a-zA-Z_\-]{60,70}"), [".atlasv1."],
@@ -321,7 +360,7 @@ def builtin_rules() -> list[Rule]:
            ws(r"eyJrIjoi[0-9a-zA-Z_=\-]{60,100}"), ["eyJrIjoi"], secret_group_name=SECRET_GROUP),
         _r("grafana-service-account-token", "Grafana", "Grafana service account token", S.MEDIUM,
            ws(r"glsa_[0-9a-zA-Z_]{32}_[0-9a-f]{8}"), ["glsa_"], secret_group_name=SECRET_GROUP),
-        _r("newrelic-user-api-key", "NewRelic", "New Relic user API key", S.MEDIUM,
+        _r("new-relic-user-api-key", "NewRelic", "New Relic user API key", S.MEDIUM,
            ws(r"NRAK-[0-9A-Z]{27}"), ["NRAK-"], secret_group_name=SECRET_GROUP),
         _r("datadog-access-token", "Datadog", "Datadog access token", S.MEDIUM,
            r"(?i)datadog[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}(?P<secret>[0-9a-f]{40})",
@@ -336,7 +375,7 @@ def builtin_rules() -> list[Rule]:
         _r("openai-api-key", "OpenAI", "OpenAI API key", S.HIGH,
            ws(r"sk-[0-9a-zA-Z]{20}T3BlbkFJ[0-9a-zA-Z]{20}"), ["T3BlbkFJ"],
            secret_group_name=SECRET_GROUP),
-        _r("huggingface-access-token", "HuggingFace", "Hugging Face access token", S.HIGH,
+        _r("hugging-face-access-token", "HuggingFace", "Hugging Face access token", S.HIGH,
            ws(r"hf_[a-zA-Z]{34}"), ["hf_"], secret_group_name=SECRET_GROUP),
         _r("anthropic-api-key", "Anthropic", "Anthropic API key", S.HIGH,
            ws(r"sk-ant-[a-zA-Z0-9_\-]{20,120}"), ["sk-ant-"], secret_group_name=SECRET_GROUP),
@@ -349,24 +388,171 @@ def builtin_rules() -> list[Rule]:
            r"(?i)asana[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
            r"(?P<secret>[0-9]/[0-9]{10,16}:[0-9a-f]{32})",
            ["asana"], secret_group_name=SECRET_GROUP),
-        _r("dropbox-short-lived-token", "Dropbox", "Dropbox short-lived access token", S.MEDIUM,
+        _r("dropbox-short-lived-api-token", "Dropbox", "Dropbox short-lived API token", S.MEDIUM,
            ws(r"sl\.[0-9a-zA-Z_\-]{130,152}"), ["sl."], secret_group_name=SECRET_GROUP),
         _r("netlify-access-token", "Netlify", "Netlify access token", S.MEDIUM,
            r"(?i)netlify[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}"
            r"(?P<secret>[0-9a-zA-Z_\-]{40,46})",
            ["netlify"], secret_group_name=SECRET_GROUP),
-        _r("linear-api-key", "Linear", "Linear API key", S.MEDIUM,
+        _r("linear-api-token", "Linear", "Linear API token", S.MEDIUM,
            ws(r"lin_api_[0-9a-zA-Z]{40}"), ["lin_api_"], secret_group_name=SECRET_GROUP),
         _r("postman-api-token", "Postman", "Postman API token", S.MEDIUM,
            ws(r"PMAK-[0-9a-f]{24}-[0-9a-f]{34}"), ["PMAK-"], secret_group_name=SECRET_GROUP),
         _r("sentry-access-token", "Sentry", "Sentry auth token", S.MEDIUM,
            r"(?i)sentry[a-z0-9_\-\s\"']{0,25}[=:][\s\"']{0,5}(?P<secret>[0-9a-f]{64})",
            ["sentry"], secret_group_name=SECRET_GROUP),
-        _r("facebook-access-token", "Facebook", "Facebook access token", S.HIGH,
+        _r("facebook-token", "Facebook", "Facebook access token", S.HIGH,
            ws(r"EAACEdEose0cBA[0-9A-Za-z]+"), ["EAACEdEose0cBA"], secret_group_name=SECRET_GROUP),
         _r("twitter-bearer-token", "Twitter", "Twitter/X bearer token", S.MEDIUM,
            ws(r"AAAAAAAAAAAAAAAAAAAAA[0-9a-zA-Z%]{60,120}"), ["AAAAAAAAAAAAAAAAAAAAA"],
            secret_group_name=SECRET_GROUP),
+        # ----- SaaS breadth (reference rule-ID parity set) --------------------
+        _r("adobe-client-id", "Adobe", "Adobe client ID (OAuth web)", S.MEDIUM,
+           kw("adobe", r"[a-f0-9]{32}", "a-f0-9"),
+           ["adobe"], secret_group_name=SECRET_GROUP),
+        _r("adobe-client-secret", "Adobe", "Adobe client secret", S.HIGH,
+           ws(r"p8e-[a-z0-9]{32}"), ["p8e-"], secret_group_name=SECRET_GROUP),
+        _r("alibaba-secret-key", "Alibaba", "Alibaba Cloud AccessKey secret", S.CRITICAL,
+           kw("alibaba", r"[a-zA-Z0-9]{30}", "a-zA-Z0-9"),
+           ["alibaba"], secret_group_name=SECRET_GROUP),
+        _r("asana-client-id", "Asana", "Asana client ID", S.MEDIUM,
+           kw("asana", r"[0-9]{16}", "0-9"),
+           ["asana"], secret_group_name=SECRET_GROUP),
+        _r("asana-client-secret", "Asana", "Asana client secret", S.HIGH,
+           kw("asana", r"[a-z0-9]{32}", "a-z0-9"),
+           ["asana"], secret_group_name=SECRET_GROUP),
+        _r("beamer-api-token", "Beamer", "Beamer API token", S.MEDIUM,
+           kw("beamer", r"b_[a-z0-9=_\-]{44}"),
+           ["beamer"], secret_group_name=SECRET_GROUP),
+        _r("bitbucket-client-id", "Bitbucket", "Bitbucket client ID", S.MEDIUM,
+           kw("bitbucket", r"[a-zA-Z0-9]{32}", "a-zA-Z0-9"),
+           ["bitbucket"], secret_group_name=SECRET_GROUP),
+        _r("bitbucket-client-secret", "Bitbucket", "Bitbucket client secret", S.HIGH,
+           kw("bitbucket", r"[a-zA-Z0-9=_\-]{64}", r"a-zA-Z0-9=_\-"),
+           ["bitbucket"], secret_group_name=SECRET_GROUP),
+        _r("contentful-delivery-api-token", "Contentful", "Contentful delivery API token",
+           S.MEDIUM, ws(r"CFPAT-[a-zA-Z0-9_\-]{43}"), ["CFPAT-"],
+           secret_group_name=SECRET_GROUP),
+        _r("discord-api-token", "Discord", "Discord API key", S.HIGH,
+           kw("discord", r"[a-f0-9]{64}", "a-f0-9"),
+           ["discord"], secret_group_name=SECRET_GROUP),
+        _r("discord-client-id", "Discord", "Discord client ID", S.LOW,
+           kw("discord", r"[0-9]{18}", "0-9"),
+           ["discord"], secret_group_name=SECRET_GROUP),
+        _r("discord-client-secret", "Discord", "Discord client secret", S.HIGH,
+           kw("discord", r"[a-zA-Z0-9=_\-]{32}", r"a-zA-Z0-9=_\-"),
+           ["discord"], secret_group_name=SECRET_GROUP),
+        _r("dockerconfig-secret", "Docker", "Dockerconfig secret", S.HIGH,
+           r"(?i)(?:\.dockerconfigjson|\.dockercfg)[\s\"']{0,5}:[\s\"']{0,5}"
+           r"(?P<secret>[A-Za-z0-9+/=]{40,4000})",
+           [".dockerconfigjson", ".dockercfg"], secret_group_name=SECRET_GROUP),
+        _r("dropbox-api-secret", "Dropbox", "Dropbox API secret", S.HIGH,
+           kw("dropbox", r"[a-z0-9]{15}", "a-z0-9"),
+           ["dropbox"], secret_group_name=SECRET_GROUP),
+        _r("dropbox-long-lived-api-token", "Dropbox", "Dropbox long-lived API token", S.HIGH,
+           kw("dropbox", r"[a-z0-9]{11}(?:AAAAAAAAAA)[a-z0-9\-_=]{43}"),
+           ["dropbox"], secret_group_name=SECRET_GROUP),
+        _r("duffel-api-token", "Duffel", "Duffel API token", S.HIGH,
+           ws(r"duffel_(?:test|live)_[a-zA-Z0-9_\-=]{43}"), ["duffel_"],
+           secret_group_name=SECRET_GROUP),
+        _r("dynatrace-api-token", "Dynatrace", "Dynatrace API token", S.HIGH,
+           ws(r"dt0c01\.[a-zA-Z0-9]{24}\.[a-z0-9]{64}"), ["dt0c01."],
+           secret_group_name=SECRET_GROUP),
+        _r("easypost-api-token", "EasyPost", "EasyPost API token", S.HIGH,
+           ws(r"EZ[AT]K[a-zA-Z0-9]{54}"), ["EZAK", "EZTK"],
+           secret_group_name=SECRET_GROUP),
+        _r("fastly-api-token", "Fastly", "Fastly API token", S.HIGH,
+           kw("fastly", r"[a-zA-Z0-9=_\-]{32}", r"a-zA-Z0-9=_\-"),
+           ["fastly"], secret_group_name=SECRET_GROUP),
+        _r("finicity-api-token", "Finicity", "Finicity API token", S.HIGH,
+           kw("finicity", r"[a-f0-9]{32}", "a-f0-9"),
+           ["finicity"], secret_group_name=SECRET_GROUP),
+        _r("finicity-client-secret", "Finicity", "Finicity client secret", S.HIGH,
+           kw("finicity", r"[a-z0-9]{20}", "a-z0-9"),
+           ["finicity"], secret_group_name=SECRET_GROUP),
+        _r("flutterwave-enc-key", "Flutterwave", "Flutterwave encryption key", S.HIGH,
+           ws(r"FLWSECK_TEST-[a-h0-9]{12}"), ["FLWSECK_TEST"],
+           secret_group_name=SECRET_GROUP),
+        _r("flutterwave-public-key", "Flutterwave", "Flutterwave public key", S.MEDIUM,
+           ws(r"FLWPUBK_TEST-[a-h0-9]{32}-X"), ["FLWPUBK_TEST"],
+           secret_group_name=SECRET_GROUP),
+        _r("frameio-api-token", "Frame.io", "Frame.io API token", S.HIGH,
+           ws(r"fio-u-[a-zA-Z0-9\-_=]{64}"), ["fio-u-"], secret_group_name=SECRET_GROUP),
+        _r("gocardless-api-token", "GoCardless", "GoCardless API token", S.HIGH,
+           kw("gocardless", r"live_[a-zA-Z0-9\-_=]{40}"),
+           ["gocardless"], secret_group_name=SECRET_GROUP),
+        _r("hubspot-api-token", "HubSpot", "HubSpot API token", S.HIGH,
+           kw("hubspot",
+              r"[a-h0-9]{8}-[a-h0-9]{4}-[a-h0-9]{4}-[a-h0-9]{4}-[a-h0-9]{12}"),
+           ["hubspot"], secret_group_name=SECRET_GROUP),
+        _r("intercom-api-token", "Intercom", "Intercom API token", S.HIGH,
+           kw("intercom", r"[a-zA-Z0-9=_]{60}", "a-zA-Z0-9=_"),
+           ["intercom"], secret_group_name=SECRET_GROUP),
+        _r("intercom-client-secret", "Intercom", "Intercom client secret/ID", S.HIGH,
+           kw("intercom",
+              r"[a-h0-9]{8}-[a-h0-9]{4}-[a-h0-9]{4}-[a-h0-9]{4}-[a-h0-9]{12}"),
+           ["intercom"], secret_group_name=SECRET_GROUP),
+        _r("ionic-api-token", "Ionic", "Ionic API token", S.HIGH,
+           ws(r"ion_[a-z0-9]{42}"), ["ion_"], secret_group_name=SECRET_GROUP),
+        _r("linear-client-secret", "Linear", "Linear client secret", S.HIGH,
+           kw("linear", r"[a-f0-9]{32}", "a-f0-9"),
+           ["linear"], secret_group_name=SECRET_GROUP),
+        _r("linkedin-client-id", "LinkedIn", "LinkedIn client ID", S.MEDIUM,
+           kw(r"linked[_\-]?in", r"[a-z0-9]{14}", "a-z0-9"),
+           ["linkedin", "linked_in", "linked-in"], secret_group_name=SECRET_GROUP),
+        _r("linkedin-client-secret", "LinkedIn", "LinkedIn client secret", S.HIGH,
+           kw(r"linked[_\-]?in", r"[a-z0-9]{16}", "a-z0-9"),
+           ["linkedin", "linked_in", "linked-in"], secret_group_name=SECRET_GROUP),
+        _r("lob-api-key", "Lob", "Lob API key", S.HIGH,
+           kw("lob", r"(?:live|test)_[a-f0-9]{35}"),
+           ["lob"], secret_group_name=SECRET_GROUP),
+        _r("lob-pub-api-key", "Lob", "Lob publishable API key", S.MEDIUM,
+           kw("lob", r"(?:test|live)_pub_[a-f0-9]{31}"),
+           ["lob"], secret_group_name=SECRET_GROUP),
+        _r("mailgun-signing-key", "Mailgun", "Mailgun webhook signing key", S.HIGH,
+           kw("mailgun", r"[a-h0-9]{32}-[a-h0-9]{8}-[a-h0-9]{8}"),
+           ["mailgun"], secret_group_name=SECRET_GROUP),
+        _r("mapbox-api-token", "Mapbox", "Mapbox API token", S.MEDIUM,
+           kw("mapbox", r"pk\.[a-z0-9]{60}\.[a-z0-9]{22}"),
+           ["mapbox"], secret_group_name=SECRET_GROUP),
+        _r("messagebird-api-token", "MessageBird", "MessageBird API token", S.HIGH,
+           kw(r"message[_\-]?bird", r"[a-z0-9]{25}", "a-z0-9"),
+           ["messagebird", "message_bird", "message-bird"],
+           secret_group_name=SECRET_GROUP),
+        _r("messagebird-client-id", "MessageBird", "MessageBird client ID", S.MEDIUM,
+           kw(r"message[_\-]?bird",
+              r"[a-h0-9]{8}-[a-h0-9]{4}-[a-h0-9]{4}-[a-h0-9]{4}-[a-h0-9]{12}"),
+           ["messagebird", "message_bird", "message-bird"],
+           secret_group_name=SECRET_GROUP),
+        _r("new-relic-browser-api-token", "NewRelic", "New Relic ingest browser API token",
+           S.MEDIUM, ws(r"NRJS-[a-f0-9]{19}"), ["NRJS-"], secret_group_name=SECRET_GROUP),
+        _r("new-relic-user-api-id", "NewRelic", "New Relic user API ID", S.MEDIUM,
+           kw(r"(?:new[_\-]?relic|nrak)", r"[A-Z0-9]{64}", "A-Z0-9"),
+           ["newrelic", "new_relic", "new-relic", "nrak"],
+           secret_group_name=SECRET_GROUP),
+        _r("planetscale-api-token", "PlanetScale", "PlanetScale API token", S.HIGH,
+           ws(r"pscale_tkn_[a-zA-Z0-9\-_\.]{43}"), ["pscale_tkn_"],
+           secret_group_name=SECRET_GROUP),
+        _r("planetscale-password", "PlanetScale", "PlanetScale password", S.HIGH,
+           ws(r"pscale_pw_[a-zA-Z0-9\-_\.]{43}"), ["pscale_pw_"],
+           secret_group_name=SECRET_GROUP),
+        _r("private-packagist-token", "Packagist", "Private Packagist token", S.HIGH,
+           ws(r"packagist_[ou][ru]t_[a-f0-9]{68}"), ["packagist_"],
+           secret_group_name=SECRET_GROUP),
+        _r("sendinblue-api-token", "Sendinblue", "Sendinblue API token", S.HIGH,
+           ws(r"xkeysib-[a-f0-9]{64}-[a-zA-Z0-9]{16}"), ["xkeysib-"],
+           secret_group_name=SECRET_GROUP),
+        _r("shippo-api-token", "Shippo", "Shippo API token", S.HIGH,
+           ws(r"shippo_(?:live|test)_[a-f0-9]{40}"), ["shippo_"],
+           secret_group_name=SECRET_GROUP),
+        _r("twitch-api-token", "Twitch", "Twitch API token", S.HIGH,
+           kw("twitch", r"[a-z0-9]{30}", "a-z0-9"),
+           ["twitch"], secret_group_name=SECRET_GROUP),
+        _r("twitter-token", "Twitter", "Twitter token", S.MEDIUM,
+           kw("twitter", r"[a-z0-9]{35,44}", "a-z0-9"),
+           ["twitter"], secret_group_name=SECRET_GROUP),
+        _r("typeform-api-token", "Typeform", "Typeform API token", S.MEDIUM,
+           ws(r"tfp_[a-z0-9\-_\.=]{59}"), ["tfp_"], secret_group_name=SECRET_GROUP),
         # ----- generic fallbacks ----------------------------------------------
         _r("basic-auth-url", CategoryGeneric, "Credentials embedded in URL", S.HIGH,
            r"[a-zA-Z][a-zA-Z0-9+.\-]{1,9}://[^/\s:@\"']{1,64}:(?P<secret>[^/\s:@\"']{3,64})@"
