@@ -60,3 +60,24 @@ def test_pallas_interpret_matches_xla():
     # the offset-0 rows must actually hit something (guards against the
     # equality above passing with both kernels missing)
     assert hx[1::2].any(axis=1).all()
+
+
+def test_all_host_lane_ruleset_builds_noop_kernel():
+    """A ruleset with no device variants and no keywords must still build a
+    dispatchable (no-op) match fn instead of crashing on an empty kernel
+    list."""
+    import numpy as np
+
+    from trivy_tpu.ops.match_pallas import build_match_fn_pallas
+    from trivy_tpu.secret.device_compile import compile_rules
+    from trivy_tpu.secret.rules import Rule
+    from trivy_tpu.types import Severity
+
+    rule = Rule(id="host-only", category="c", title="t", severity=Severity.LOW,
+                regex=r"(?:\d+[a-z]\d+){1,9}zz", keywords=[])
+    compiled = compile_rules([rule])
+    assert not compiled.variants and not compiled.keywords
+    fn = build_match_fn_pallas(compiled, 1024)
+    out = np.asarray(fn(np.zeros((8, 1024), dtype=np.uint8)))
+    assert out.shape == (8, compiled.num_rules)
+    assert not out.any()

@@ -34,6 +34,7 @@ class ArtifactOption:
     backend: str = "auto"
     insecure: bool = False
     analyzer_extra: dict = field(default_factory=dict)
+    parallel: int = 0  # host worker count (--parallel); 0 = defaults
 
 
 class LocalFSArtifact:
@@ -79,7 +80,8 @@ class LocalFSArtifact:
         # ahead of the (serial) analyzer loop — the TPU-era equivalent of the
         # reference's per-file goroutine fan-out (ref: analyzer.go:403-455),
         # restructured as read-ahead feeding batched device collection
-        with ThreadPoolExecutor(max_workers=self.READ_WORKERS) as pool:
+        workers = self.option.parallel or self.READ_WORKERS
+        with ThreadPoolExecutor(max_workers=workers) as pool:
             window: deque = deque()  # (rel, info, future)
             buffered = 0
             for rel, info, opener in self.walker.walk(self.root):

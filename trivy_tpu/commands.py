@@ -56,7 +56,9 @@ def _artifact_option(ns, opts):
         analyzer_extra={
             "check_paths": list(opts.get("config_check") or []),
             "misconfig_scanners": list(opts.get("misconfig_scanners") or []),
+            "parallel": max(0, int(opts.get("parallel") or 0)),
         },
+        parallel=max(0, int(opts.get("parallel") or 0)),
     )
 
 

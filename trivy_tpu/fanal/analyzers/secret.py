@@ -73,6 +73,7 @@ class SecretAnalyzer(BatchAnalyzer):
         backend = getattr(options, "backend", "auto")
         self._config = cfg
         self._backend = backend
+        self._parallel = int((getattr(options, "extra", {}) or {}).get("parallel", 0))
         self._scanner = None  # built lazily so CPU-only runs never touch jax
         self._files: list[tuple[str, bytes]] = []
         self._buffered = 0
@@ -104,7 +105,9 @@ class SecretAnalyzer(BatchAnalyzer):
             else:
                 from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
 
-                self._scanner = TpuSecretScanner(self._config)
+                self._scanner = TpuSecretScanner(
+                    self._config, confirm_workers=self._parallel
+                )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 
     @staticmethod

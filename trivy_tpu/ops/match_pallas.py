@@ -42,7 +42,11 @@ from trivy_tpu.secret.device_compile import CompiledRules, Variant
 
 BLOCK_ROWS = 8  # i32 sublane tile
 # masks per group: (masks + overhead) * BLOCK_ROWS*C*4 bytes must fit VMEM
-GROUP_MASK_BUDGET = 24
+GROUP_MASK_BUDGET = 48
+# keywords per kernel: each literal check keeps a few [TB, Cp] planes alive;
+# batching bounds the keyword kernel's VMEM stack the same way the mask
+# budget bounds the anchored groups
+KEYWORD_BATCH = 72
 
 
 def _class_intervals(compiled: CompiledRules):
@@ -105,7 +109,7 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
     class_intervals = _class_intervals(compiled)
     var_groups = _group_variants(compiled.variants, GROUP_MASK_BUDGET)
 
-    def make_kernel(group, with_keywords: bool):
+    def make_kernel(group, keywords=()):
         def kernel(x_ref, out_ref):
             x = x_ref[:].astype(jnp.int32)  # [TB, Cp] zero-padded rows
 
@@ -124,10 +128,43 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                 z = jnp.zeros_like(arr[:, :w])
                 return jnp.concatenate([arr[:, w:], z], axis=1)
 
-            def literal_hit(lit: bytes, data):
-                ok = b(shift(data, 0) == lit[0])
-                for j in range(1, len(lit)):
-                    ok &= b(shift(data, j) == lit[j])
+            packed_cache: dict[int, jax.Array] = {}
+
+            def packed4(key: int, data):
+                """P[p] = bytes p..p+3 of ``data`` packed big-endian into one
+                i32 — shared by every literal in the kernel, so an L-byte
+                literal costs ~L/4 plane compares instead of L."""
+                if key not in packed_cache:
+                    packed_cache[key] = (
+                        (data << 24)
+                        | (roll(data, 1) << 16)
+                        | (roll(data, 2) << 8)
+                        | roll(data, 3)
+                    )
+                return packed_cache[key]
+
+            def _word(lit: bytes, j: int) -> int:
+                return int(np.int32(np.uint32(int.from_bytes(lit[j : j + 4], "big"))))
+
+            def literal_hit(lit: bytes, data, key: int = 0):
+                """All-packed literal check: words at offsets 0,4,8,... plus an
+                overlapping final word at len-4, so compares hit the shared
+                shift cache (offsets are multiples of 4 or one of few tails)."""
+                L = len(lit)
+                if L < 4:
+                    ok = None
+                    for j in range(L):
+                        t = b(shift(data, j) == lit[j])
+                        ok = t if ok is None else ok & t
+                    return ok
+                P = packed4(key, data)
+                offs = list(range(0, L - 3, 4))
+                if offs[-1] != L - 4:
+                    offs.append(L - 4)  # overlapping tail word
+                ok = None
+                for j in offs:
+                    t = b(shift(P, j) == _word(lit, j))
+                    ok = t if ok is None else ok & t
                 return ok
 
             def in_class(cid):
@@ -184,10 +221,10 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                     jnp.maximum(per_rule[ridx], col) if ridx in per_rule else col
                 )
 
-            if with_keywords:
+            if keywords:
                 xl = jnp.where((x >= 65) & (x <= 90), x + 32, x)
-                for ridx, kw in compiled.keywords:
-                    ok = literal_hit(kw, xl)
+                for ridx, kw in keywords:
+                    ok = literal_hit(kw, xl, key=1)
                     col = jnp.max(ok, axis=1, keepdims=True)
                     per_rule[ridx] = (
                         jnp.maximum(per_rule[ridx], col) if ridx in per_rule else col
@@ -199,8 +236,17 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
 
         return kernel
 
-    kernels = [make_kernel(g, False) for g in var_groups]
-    kernels.append(make_kernel([], True))  # keyword group
+    kernels = [make_kernel(g) for g in var_groups]
+    kws = list(compiled.keywords)
+    for i in range(0, len(kws), KEYWORD_BATCH):
+        kernels.append(make_kernel([], keywords=tuple(kws[i : i + KEYWORD_BATCH])))
+    if not kernels:
+        # every rule is host-lane: nothing to check on device
+        @jax.jit
+        def no_op(chunks: jax.Array) -> jax.Array:
+            return jnp.zeros((chunks.shape[0], R), dtype=bool)
+
+        return no_op
 
     @jax.jit
     def fn(chunks: jax.Array) -> jax.Array:
@@ -221,6 +267,12 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                     ],
                     out_specs=pl.BlockSpec(
                         (BLOCK_ROWS, R), lambda i: (i, 0), memory_space=pltpu.VMEM
+                    ),
+                    compiler_params=pltpu.CompilerParams(
+                        # the default 16 MiB scoped limit is what the group
+                        # packing targets; headroom absorbs Mosaic's stack
+                        # bookkeeping so ruleset growth can't OOM compilation
+                        vmem_limit_bytes=64 * 1024 * 1024,
                     ),
                 )(padded)
             )

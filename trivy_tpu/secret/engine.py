@@ -236,10 +236,9 @@ class SecretScanner:
         if not rule.match_keywords(lower):  # keywords are a whole-file test
             return []
         wmax = rule.max_match_width
-        if wmax is None or wmax > 8192 or rule.has_lookaround or rule.has_end_anchor:
-            # lookarounds examine context beyond getwidth()'s bound, and end
-            # anchors ($/\Z) match at finditer's endpos even mid-content, so
-            # the fixed padding below cannot guarantee parity — full scan
+        if wmax is None or wmax > 8192 or rule.has_lookaround:
+            # lookarounds examine context beyond getwidth()'s bound, so the
+            # fixed padding below cannot guarantee parity — full scan instead
             return self.find_rule_locations(rule, content, lower, global_blocks)
         n = len(content)
         # slack beyond the match width for anchor/word-prefix context; rules
@@ -252,9 +251,24 @@ class SecretScanner:
                 merged[-1][1] = max(merged[-1][1], e)
             else:
                 merged.append([s, e])
+        verify_edges = rule.has_end_anchor
         locs: list[Location] = []
         for s, e in merged:
-            for m in rule.regex_re.finditer(content, s, e):
+            pos = s
+            while pos <= e:
+                m = rule.regex_re.search(content, pos, e)
+                if m is None:
+                    break
+                if verify_edges and e < n and m.end() >= e - 1:
+                    # finditer's endpos acts as end-of-string, so a terminal
+                    # $/\Z (incl. the before-trailing-\n form) may have fired
+                    # mid-content; re-match at the same start against the real
+                    # string end — the authoritative span the full scan sees
+                    m2 = rule.regex_re.match(content, m.start())
+                    if m2 is None:
+                        pos = m.start() + 1
+                        continue
+                    m = m2
                 if (
                     rule.secret_group_name
                     and rule.secret_group_name in rule.regex_re.groupindex
@@ -262,6 +276,8 @@ class SecretScanner:
                     start, end = m.span(rule.secret_group_name)
                 else:
                     start, end = m.span()
+                # non-overlapping consumption order, as finditer would do
+                pos = m.end() if m.end() > pos else pos + 1
                 if start == end or start < 0:
                     continue
                 locs.append(Location(start, end))

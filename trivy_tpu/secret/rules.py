@@ -147,10 +147,11 @@ class Rule:
 
     @cached_property
     def has_end_anchor(self) -> bool:
-        """True when the pattern can match ``$``/``\\Z``. ``finditer(pos,
+        """True when the pattern can match ``$``/``\\Z``. ``search(pos,
         endpos)`` treats endpos as end-of-string, so an end anchor matches at
         a window edge where the full scan (with real trailing content) would
-        not — such rules must take the full-content path for parity."""
+        not — window-restricted scanning re-verifies such edge matches
+        against the real string end (engine.find_rule_locations_in_windows)."""
         try:
             import re._constants as sre_c
             import re._parser as sre_parse

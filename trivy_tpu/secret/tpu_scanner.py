@@ -41,9 +41,14 @@ logger = log.logger("secret:tpu")
 
 DEFAULT_CHUNK_LEN = 65536
 DEFAULT_BATCH = 64
-# pallas path: small self-contained rows, large batches (32 MB per dispatch)
+# pallas path: small self-contained rows.
+# 1024 x 8 KiB = 8 MiB batches: small enough that pack -> transfer ->
+# kernel -> confirm overlap through the pipeline (a 32 MiB batch serializes
+# the whole corpus behind one blocking device wait), big enough to amortize
+# kernel launch; 8 KiB rows keep the kernel's VMEM working set off the
+# spill cliff that 16 KiB rows hit
 PALLAS_CHUNK_LEN = 8192
-PALLAS_BATCH = 4096
+PALLAS_BATCH = 1024
 # batches in flight before the oldest result is fetched
 PIPELINE_DEPTH = 3
 # workers for exact host confirmation (overlaps device-result waits)
@@ -83,6 +88,7 @@ class TpuSecretScanner:
         batch_size: int | None = None,
         mesh=None,
         backend: str = "auto",
+        confirm_workers: int = 0,  # 0 = CONFIRM_WORKERS default
     ):
         import jax
 
@@ -112,6 +118,7 @@ class TpuSecretScanner:
                 f">= {2 * self.overlap}"
             )
         self._rules_by_id = {r.id: r for r in self.exact.rules}
+        self.confirm_workers = confirm_workers or CONFIRM_WORKERS
 
         from trivy_tpu.parallel.mesh import pad_batch, sharded_match_fn
 
@@ -144,10 +151,20 @@ class TpuSecretScanner:
         next_emit = 0
         total = 0
 
-        buf = np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
+        # ring of host batch buffers: a buffer is only refilled once its
+        # dispatch has resolved (inflight is bounded by PIPELINE_DEPTH), so
+        # no copy or re-zeroing per batch is needed — crucial because on the
+        # CPU backend jax may alias the numpy buffer zero-copy, and mutating
+        # a dispatched batch would corrupt it mid-flight
+        bufs = [
+            np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
+            for _ in range(PIPELINE_DEPTH + 1)
+        ]
+        buf_i = 0
+        buf = bufs[0]
         meta: list[int] = []  # file index per buffered chunk
         inflight: deque = deque()  # (device_result, meta_snapshot)
-        pool = ThreadPoolExecutor(max_workers=CONFIRM_WORKERS)
+        pool = ThreadPoolExecutor(max_workers=self.confirm_workers)
 
         def resolve(batch_hits: np.ndarray, batch_meta: list) -> None:
             # one vectorized nonzero per batch, not one per row
@@ -165,14 +182,19 @@ class TpuSecretScanner:
                     del states[fidx]
 
         def flush():
-            nonlocal meta, buf
+            nonlocal meta, buf, buf_i
             if not meta:
                 return
             n = next(b for b in self._buckets if b >= len(meta))
             dev = self._match(buf[:n])  # async dispatch, fixed bucket shape
             inflight.append((dev, meta))
             meta = []
-            buf = np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
+            # rotate to the next ring buffer; full rows are overwritten on
+            # fill and partial rows zero their own tails (stale rows past
+            # len(meta) are sliced off in resolve), so no re-zeroing of the
+            # whole batch is needed
+            buf_i = (buf_i + 1) % len(bufs)
+            buf = bufs[buf_i]
             while len(inflight) >= PIPELINE_DEPTH:
                 d, m = inflight.popleft()
                 resolve(np.asarray(d), m)
@@ -195,7 +217,10 @@ class TpuSecretScanner:
                     arr = np.frombuffer(data, dtype=np.uint8)
                     for s in starts:
                         piece = arr[s : s + self.chunk_len]
-                        buf[len(meta), : len(piece)] = piece
+                        row = len(meta)
+                        buf[row, : len(piece)] = piece
+                        if len(piece) < self.chunk_len:
+                            buf[row, len(piece):] = 0  # clear stale tail
                         meta.append((fidx, s))
                         if len(meta) == self.batch_size:
                             flush()
