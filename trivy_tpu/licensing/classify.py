@@ -23,6 +23,10 @@ from trivy_tpu.types import LicenseFinding
 
 _SPDX_URL = "https://spdx.org/licenses/{}.html"
 
+# cap on chunk rows per device dispatch (4096 x 8 KiB = 32 MiB): large
+# inputs split across bounded dispatches instead of one giant padded batch
+MAX_DEVICE_ROWS = 4096
+
 
 class LicenseClassifier:
     """classify(text) -> [LicenseFinding]; classify_batch for many files."""
@@ -67,10 +71,20 @@ class LicenseClassifier:
                 meta.append(ti)
         if not rows:
             return [[] for _ in texts]
-        from trivy_tpu.parallel.mesh import pad_batch
-
-        batch = pad_batch(np.stack(rows), 8)
-        hits = np.asarray(match_fn(batch))[: len(meta)]  # [rows, n_phrases]
+        # pad each dispatch's row count to a power-of-two bucket so every
+        # shape compiles exactly once; the ladder is capped so huge inputs
+        # split across bounded dispatches instead of one giant batch
+        all_rows = np.stack(rows)
+        hit_parts = []
+        for off in range(0, len(all_rows), MAX_DEVICE_ROWS):
+            part = all_rows[off : off + MAX_DEVICE_ROWS]
+            bucket = 8
+            while bucket < len(part):
+                bucket *= 2
+            batch = np.zeros((bucket, chunk_len), dtype=np.uint8)
+            batch[: len(part)] = part
+            hit_parts.append(np.asarray(match_fn(batch))[: len(part)])
+        hits = np.concatenate(hit_parts)  # [rows, n_phrases]
         per_text = np.zeros((len(texts), len(self.phrases)), dtype=bool)
         for row, ti in enumerate(meta):
             per_text[ti] |= hits[row]
