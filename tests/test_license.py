@@ -1,0 +1,216 @@
+"""License depth tests: SPDX normalization, expression grammar, corpus
+breadth, n-gram confidence, category mapping
+(ref: pkg/licensing/normalize_test.go, pkg/licensing/expression/)."""
+
+from __future__ import annotations
+
+import pytest
+
+from trivy_tpu.licensing import expression, normalize as norm_mod
+from trivy_tpu.licensing.classify import LicenseClassifier
+from trivy_tpu.licensing.corpus import NORMALIZED_FINGERPRINTS
+from trivy_tpu.licensing.scanner import LicenseCategorizer
+
+
+class TestNormalize:
+    @pytest.mark.parametrize(
+        "raw,want",
+        [
+            ("Apache License, Version 2.0", "Apache-2.0"),
+            ("apache-2.0", "Apache-2.0"),
+            ("ASL 2.0", "Apache-2.0"),
+            ("BSD", "BSD-3-Clause"),
+            ("New BSD", "BSD-3-Clause"),
+            ("Simplified BSD", "BSD-2-Clause"),
+            ("MIT License", "MIT"),
+            ("Expat", "MIT"),
+            ("GPLv2", "GPL-2.0-only"),
+            ("GPL-2.0+", "GPL-2.0-or-later"),
+            ("GPL-2.0-or-later", "GPL-2.0-or-later"),
+            ("GPL", "GPL-2.0-or-later"),  # bare GPL defaults to 2.0+
+            ("LGPL 2.1", "LGPL-2.1-only"),
+            ("GNU Lesser General Public License", "LGPL-2.0-or-later"),
+            ("MPL 2.0", "MPL-2.0"),
+            ("Eclipse Public License", "EPL-1.0"),
+            ("CDDL", "CDDL-1.0"),
+            ("Public Domain", "Unlicense"),
+            ("zlib License", "Zlib"),
+            ("Boost Software License", "BSL-1.0"),
+            ("The Unlicense", "Unlicense"),
+            ("ISCL", "ISC"),
+        ],
+    )
+    def test_aliases(self, raw, want):
+        assert norm_mod.normalize(raw) == want
+
+    def test_unknown_passthrough(self):
+        assert norm_mod.normalize("My Custom License") == "My Custom License"
+
+
+class TestExpression:
+    def test_simple(self):
+        expr = expression.parse("MIT")
+        assert expr.render() == "MIT"
+
+    def test_and_or_precedence(self):
+        expr = expression.parse("MIT OR Apache-2.0 AND GPL-2.0-only")
+        # AND binds tighter: MIT OR (Apache-2.0 AND GPL-2.0-only)
+        assert expr.op == "OR"
+        assert expr.right.op == "AND"
+
+    def test_parens(self):
+        expr = expression.parse("(MIT OR ISC) AND Apache-2.0")
+        assert expr.op == "AND"
+        assert expr.render() == "(MIT OR ISC) AND Apache-2.0"
+
+    def test_with_exception(self):
+        expr = expression.parse("GPL-2.0-only WITH Classpath-exception-2.0")
+        assert expr.exception == "Classpath-exception-2.0"
+        assert "WITH" in expr.render()
+
+    def test_plus(self):
+        expr = expression.parse("GPL-2.0+")
+        assert expr.plus
+
+    def test_errors(self):
+        for bad in ("", "AND MIT", "MIT OR", "(MIT", "MIT )"):
+            with pytest.raises(expression.ExpressionError):
+                expression.parse(bad)
+
+    def test_normalize_expression(self):
+        got = expression.normalize_expression("(MIT or GPLv2+) and ASL2.0")
+        assert got == "(MIT OR GPL-2.0-or-later) AND Apache-2.0"
+
+    def test_leaf_licenses(self):
+        got = expression.leaf_licenses("MIT OR (BSD AND GPLv3)")
+        assert got == ["MIT", "BSD-3-Clause", "GPL-3.0-only"]
+
+    def test_non_expression_fallback(self):
+        assert expression.leaf_licenses("Apache License, Version 2.0") == ["Apache-2.0"]
+
+
+class TestCorpus:
+    def test_breadth(self):
+        assert len(NORMALIZED_FINGERPRINTS) >= 100
+
+    def test_phrases_normalized(self):
+        from trivy_tpu.licensing.corpus import normalize as norm_text
+
+        for lic, phrases in NORMALIZED_FINGERPRINTS.items():
+            assert phrases, lic
+            for ph in phrases:
+                assert norm_text(ph) == ph, (lic, ph)
+
+
+MIT_TEXT = """\
+MIT License
+
+Permission is hereby granted, free of charge, to any person obtaining a copy
+of this software and associated documentation files (the "Software"), to deal
+in the Software without restriction.
+
+The above copyright notice and this permission notice shall be included in
+all copies or substantial portions of the Software.
+
+THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND, EXPRESS OR
+IMPLIED.
+"""
+
+
+class TestClassifier:
+    def test_mit_full_confidence(self):
+        clf = LicenseClassifier(backend="cpu")
+        found = clf.classify(MIT_TEXT)
+        assert [f.name for f in found] == ["MIT"]
+        assert found[0].confidence == 1.0
+
+    def test_ngram_partial_credit(self):
+        # one phrase intact (gates the candidate), another mostly intact with
+        # a small edit: n-gram confidence grades between 0 and 1
+        text = (
+            "Permission is hereby granted, free of charge, to any person "
+            "obtaining a copy of this software. "
+            "The above copyright notice and this permission notice shall be "
+            "reproduced in all copies. "  # 'included' edited away
+            'THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND.'
+        )
+        clf = LicenseClassifier(backend="cpu", confidence=0.5)
+        found = clf.classify(text)
+        mit = [f for f in found if f.name == "MIT"]
+        assert mit and 0.5 <= mit[0].confidence < 1.0
+
+    def test_no_gate_no_finding(self):
+        clf = LicenseClassifier(backend="cpu")
+        assert clf.classify("just some ordinary readme text") == []
+
+    def test_gpl_versions_distinguished(self):
+        clf = LicenseClassifier(backend="cpu")
+        text = (
+            "GNU GENERAL PUBLIC LICENSE Version 2, June 1991 ... "
+            "This program is free software; you can redistribute it and/or modify"
+        )
+        found = clf.classify(text)
+        assert [f.name for f in found] == ["GPL-2.0-only"]
+
+    def test_sspl_busl_detected(self):
+        clf = LicenseClassifier(backend="cpu")
+        assert clf.classify(
+            "Server Side Public License VERSION 1, OCTOBER 16, 2018"
+        )[0].name == "SSPL-1.0"
+        assert clf.classify(
+            "Business Source License 1.1 ... Change Date: 2028-01-01 "
+            "Change License: Apache-2.0 x"
+        )[0].name == "BUSL-1.1"
+
+
+class TestCategorizer:
+    def test_normalized_category(self):
+        cat = LicenseCategorizer()
+        lic = cat.detect("Apache License, Version 2.0")
+        assert lic.name == "Apache-2.0"
+        assert lic.category == "notice"
+
+    def test_expression_worst_leaf(self):
+        cat = LicenseCategorizer()
+        lic = cat.detect("MIT AND AGPL-3.0-only")
+        assert lic.category == "forbidden"
+        assert lic.severity == "CRITICAL"
+
+    def test_dual_or_still_worst_leaf(self):
+        cat = LicenseCategorizer()
+        lic = cat.detect("MIT OR GPL-2.0-only")
+        assert lic.category == "restricted"
+
+    def test_user_category_override(self):
+        cat = LicenseCategorizer({"forbidden": ["MIT"]})
+        assert cat.detect("MIT").category == "forbidden"
+
+
+class TestLicenseFileAnalyzer:
+    def _scan(self, tmp_path, **flags):
+        from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+        from trivy_tpu.cache import new_cache
+        from trivy_tpu.scanner import ScanOptions, Scanner
+        from trivy_tpu.scanner.local_driver import LocalDriver
+
+        cache = new_cache("memory", None)
+        art = LocalFSArtifact(str(tmp_path), cache, ArtifactOption(backend="cpu"))
+        return Scanner(art, LocalDriver(cache)).scan_artifact(
+            ScanOptions(scanners=["license"], license_full=True)
+        )
+
+    def test_license_file_classified(self, tmp_path):
+        (tmp_path / "LICENSE").write_text(MIT_TEXT)
+        report = self._scan(tmp_path)
+        file_results = [r for r in report.results if r.cls == "license-file"]
+        assert file_results
+        lic = file_results[0].licenses[0]
+        assert lic.name == "MIT"
+        assert lic.category == "notice"
+
+    def test_header_classified(self, tmp_path):
+        src = "/*\n" + "\n".join(" * " + l for l in MIT_TEXT.splitlines()) + "\n */\n"
+        (tmp_path / "util.c").write_text(src + "int main() { return 0; }\n")
+        report = self._scan(tmp_path)
+        file_results = [r for r in report.results if r.cls == "license-file"]
+        assert file_results and file_results[0].licenses[0].name == "MIT"

@@ -1,0 +1,114 @@
+"""License-file analyzers (ref: pkg/fanal/analyzer/licensing/license.go).
+
+Two batched analyzers behind ``--license-full``:
+
+- LICENSE_FILE: canonical license files (LICENSE/COPYING/NOTICE and
+  variants) — classified whole.
+- LICENSE_HEADER: source-file headers — the first few KiB of source files,
+  classified the same way.
+
+Both collect candidates during the walk and classify them in one
+device-batched ``classify_batch`` call in finalize (the TPU replacement
+for the reference's mutex-guarded per-file licenseclassifier calls,
+ref: pkg/licensing/classifier.go:17-54).
+"""
+
+from __future__ import annotations
+
+import os.path
+
+from trivy_tpu.fanal.analyzer import (
+    AnalysisInput,
+    AnalysisResult,
+    AnalyzerType,
+    BatchAnalyzer,
+    register_analyzer,
+)
+from trivy_tpu.types import LicenseFile
+
+# canonical license file stems (ref: licensing/license.go acceptable names)
+_LICENSE_STEMS = {
+    "license", "licence", "copying", "copyright", "notice", "unlicense",
+    "licenses", "licences",
+}
+_LICENSE_EXTS = {"", ".txt", ".md", ".rst", ".html"}
+
+# source extensions whose headers are worth classifying
+_HEADER_EXTS = {
+    ".c", ".h", ".cc", ".cpp", ".hpp", ".go", ".py", ".js", ".ts", ".java",
+    ".rb", ".rs", ".sh", ".swift", ".kt", ".scala", ".cs", ".m", ".mm",
+}
+
+MAX_LICENSE_BYTES = 512 << 10  # a license file larger than this is data
+HEADER_BYTES = 4 << 10  # header classification reads the file head only
+
+
+def _is_license_file(file_path: str) -> bool:
+    base = os.path.basename(file_path).lower()
+    stem, ext = os.path.splitext(base)
+    if ext in _LICENSE_EXTS and stem in _LICENSE_STEMS:
+        return True
+    # LICENSE-MIT / LICENSE.BSD / COPYING.LIB style (check the full
+    # basename: splitext hides the dot-suffix in ext); source files named
+    # license.<ext> are code, not license texts
+    if ext in _HEADER_EXTS:
+        return False
+    return any(base.startswith(s + "-") or base.startswith(s + ".")
+               for s in ("license", "licence", "copying"))
+
+
+class _LicenseBatchAnalyzer(BatchAnalyzer):
+    kind = "license-file"
+
+    def __init__(self, options):
+        self._files: list[tuple[str, str]] = []  # (path, text)
+        backend = getattr(options, "backend", "auto")
+        self._backend = "cpu" if backend == "cpu" else "auto"
+
+    def collect(self, inp: AnalysisInput) -> None:
+        text = inp.content.decode("utf-8", "replace")
+        self._files.append((inp.file_path, text))
+
+    def finalize(self) -> AnalysisResult:
+        from trivy_tpu.licensing.classify import LicenseClassifier
+
+        files, self._files = self._files, []
+        if not files:
+            return AnalysisResult()
+        clf = LicenseClassifier(backend=self._backend)
+        per_file = clf.classify_batch([t for _p, t in files])
+        licenses = [
+            LicenseFile(type=self.kind, file_path=path, findings=findings)
+            for (path, _t), findings in zip(files, per_file)
+            if findings
+        ]
+        return AnalysisResult(licenses=licenses)
+
+
+class LicenseFileAnalyzer(_LicenseBatchAnalyzer):
+    type = AnalyzerType.LICENSE_FILE
+    version = 1
+    kind = "license-file"
+
+    def required(self, file_path: str, info) -> bool:
+        return info.size <= MAX_LICENSE_BYTES and _is_license_file(file_path)
+
+
+class LicenseHeaderAnalyzer(_LicenseBatchAnalyzer):
+    type = AnalyzerType.LICENSE_HEADER
+    version = 1
+    kind = "header"
+
+    def required(self, file_path: str, info) -> bool:
+        if info.size == 0:
+            return False
+        ext = os.path.splitext(file_path)[1].lower()
+        return ext in _HEADER_EXTS
+
+    def collect(self, inp: AnalysisInput) -> None:
+        text = inp.content[:HEADER_BYTES].decode("utf-8", "replace")
+        self._files.append((inp.file_path, text))
+
+
+register_analyzer(LicenseFileAnalyzer)
+register_analyzer(LicenseHeaderAnalyzer)

@@ -49,7 +49,7 @@ class LicenseClassifier:
         hits = np.zeros(len(self.phrases), dtype=bool)
         for i, (_li, ph) in enumerate(self.phrases):
             hits[i] = ph in norm
-        return self._findings(hits)
+        return self._findings(hits, norm)
 
     # -- batched device path ------------------------------------------------
 
@@ -61,8 +61,9 @@ class LicenseClassifier:
 
         rows = []
         meta = []  # text index per chunk row
+        norms = [normalize(t) for t in texts]
         for ti, text in enumerate(texts):
-            data = normalize(text).encode("latin-1", "replace")
+            data = norms[ti].encode("latin-1", "replace")
             for s in chunk_spans(len(data), chunk_len, overlap):
                 row = np.zeros(chunk_len, dtype=np.uint8)
                 piece = data[s : s + chunk_len]
@@ -88,7 +89,9 @@ class LicenseClassifier:
         per_text = np.zeros((len(texts), len(self.phrases)), dtype=bool)
         for row, ti in enumerate(meta):
             per_text[ti] |= hits[row]
-        return [self._findings(per_text[ti]) for ti in range(len(texts))]
+        return [
+            self._findings(per_text[ti], norms[ti]) for ti in range(len(texts))
+        ]
 
     def _build_device(self):
         if self._device is None:
@@ -128,16 +131,65 @@ class LicenseClassifier:
 
     # -- shared scoring -----------------------------------------------------
 
-    def _findings(self, phrase_hits: np.ndarray) -> list[LicenseFinding]:
-        per_license: dict[int, tuple[int, int]] = {}
-        for i, (li, _ph) in enumerate(self.phrases):
-            got, total = per_license.get(li, (0, 0))
-            per_license[li] = (got + bool(phrase_hits[i]), total + 1)
+    _NGRAM = 5  # word n-gram width for similarity confidence
+
+    @staticmethod
+    def _gram_words(text: str) -> list[str]:
+        """Tokens for n-gram scoring: edge punctuation stripped so a
+        phrase-final word matches its comma-suffixed form in running text."""
+        return [w.strip("\"'(),.;:!?") for w in text.split()]
+
+    def _phrase_units(self, li: int):
+        """Scoring units for one license: word 5-grams of its phrases (whole
+        phrase for short ones). Cached per license."""
+        if not hasattr(self, "_units_cache"):
+            self._units_cache: dict[int, list] = {}
+        if li not in self._units_cache:
+            units: list = []
+            for pli, ph in self.phrases:
+                if pli != li:
+                    continue
+                words = self._gram_words(ph)
+                if len(words) < self._NGRAM:
+                    units.append(ph)
+                else:
+                    units.extend(
+                        tuple(words[j : j + self._NGRAM])
+                        for j in range(len(words) - self._NGRAM + 1)
+                    )
+            self._units_cache[li] = units
+        return self._units_cache[li]
+
+    def _text_grams(self, norm: str) -> set:
+        words = self._gram_words(norm)
+        return {
+            tuple(words[j : j + self._NGRAM])
+            for j in range(max(0, len(words) - self._NGRAM + 1))
+        }
+
+    def _ngram_confidence(self, li: int, norm: str, grams: set) -> float:
+        """n-gram similarity (ref: the licenseclassifier's token-similarity
+        scoring, SURVEY §7): fraction of the license's phrase 5-grams present
+        in the text — graded credit for partially-rewrapped/edited texts."""
+        units = self._phrase_units(li)
+        if not units:
+            return 0.0
+        got = sum(
+            1 for u in units if (u in grams if isinstance(u, tuple) else u in norm)
+        )
+        return got / len(units)
+
+    def _findings(self, phrase_hits: np.ndarray, norm: str) -> list[LicenseFinding]:
+        # exact-phrase hits gate candidates (identical for the host path and
+        # the device keyword-lane prefilter, so both backends agree);
+        # n-gram similarity then grades the confidence
+        candidates = {li for i, (li, _ph) in enumerate(self.phrases) if phrase_hits[i]}
         found = []
-        for li, (got, total) in per_license.items():
-            conf = got / total
-            if got and conf >= self.confidence:
-                found.append((conf, total, self.licenses[li]))
+        grams = self._text_grams(norm) if candidates else set()
+        for li in candidates:
+            conf = self._ngram_confidence(li, norm, grams)
+            if conf >= self.confidence:
+                found.append((conf, len(self._phrase_units(li)), self.licenses[li]))
         if not found:
             return []
         # specificity: a fully-matched license suppresses licenses it subsumes
