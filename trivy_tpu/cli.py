@@ -133,6 +133,8 @@ def db_flags() -> FlagGroup:
                  config_name="db.skip-update", help="do not refresh the vuln DB"),
             Flag("db-repository", default=None, config_name="db.repository",
                  help="advisory DB location (dir or archive)"),
+            Flag("java-db", default=None, config_name="db.java-repository",
+                 help="java DB directory (jar sha1 -> maven coordinates)"),
             Flag("offline-scan", default=False, value_type=bool,
                  config_name="offline-scan", help="no network access"),
         ],

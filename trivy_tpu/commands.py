@@ -57,6 +57,7 @@ def _artifact_option(ns, opts):
             "check_paths": list(opts.get("config_check") or []),
             "misconfig_scanners": list(opts.get("misconfig_scanners") or []),
             "parallel": max(0, int(opts.get("parallel") or 0)),
+            "java_db_path": opts.get("java_db"),
         },
         parallel=max(0, int(opts.get("parallel") or 0)),
     )

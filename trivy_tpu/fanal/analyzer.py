@@ -55,6 +55,7 @@ class AnalyzerType(str, enum.Enum):
     # language ecosystems (post-analyzers over lockfiles)
     BUNDLER = "bundler"
     CARGO = "cargo"
+    RUST_BINARY = "rustbinary"
     COMPOSER = "composer"
     GO_MOD = "gomod"
     GO_BINARY = "gobinary"
@@ -77,6 +78,8 @@ class AnalyzerType(str, enum.Enum):
     SWIFT = "swift"
     COCOAPODS = "cocoapods"
     CONDA_PKG = "conda-pkg"
+    PYTHON_PKG = "python-pkg"
+    GEMSPEC = "gemspec"
     JULIA = "julia"
     # others
     SECRET = "secret"

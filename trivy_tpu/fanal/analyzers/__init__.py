@@ -2,7 +2,9 @@
 (ref: each reference analyzer registers via init(), pkg/fanal/analyzer)."""
 
 from trivy_tpu.fanal.analyzers import (  # noqa: F401
+    binary,
     config,
+    installed,
     lang,
     license,
     os_release,

@@ -71,20 +71,43 @@ for _t, _app, _match, _parse in _SPECS:
 
 
 class JarAnalyzer(Analyzer):
-    """Filename-based JAR identification (the reference enriches via the
-    java DB sha1 lookup, ref: parser/java/jar; offline filename lane here)."""
+    """JAR identification: sha1 → Maven GAV via the java DB when configured
+    (ref: parser/java/jar + pkg/javadb/client.go:24-47), with filename
+    parsing as the offline fallback lane."""
 
     type = AnalyzerType.JAR
-    version = 1
+    version = 2
 
     def __init__(self, options):
-        pass
+        self._db = None
+        db_path = (getattr(options, "extra", {}) or {}).get("java_db_path")
+        if db_path:
+            from trivy_tpu.javadb import JavaDB
+
+            self._db = JavaDB.load(db_path)
 
     def required(self, file_path: str, info) -> bool:
         return file_path.endswith((".jar", ".war", ".ear"))
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        pkgs = P.parse_jar_name(inp.file_path)
+        pkgs = None
+        if self._db is not None:
+            gav = self._db.lookup_content(inp.content)
+            if gav is not None:
+                from trivy_tpu.types import Package, PkgIdentifier
+
+                group, artifact, version = gav
+                name = f"{group}:{artifact}"
+                pkgs = [Package(
+                    name=name,
+                    version=version,
+                    file_path=inp.file_path,
+                    identifier=PkgIdentifier(
+                        purl=f"pkg:maven/{group}/{artifact}@{version}"
+                    ),
+                )]
+        if pkgs is None:
+            pkgs = P.parse_jar_name(inp.file_path)
         if not pkgs:
             return None
         return AnalysisResult(
