@@ -163,3 +163,32 @@ def test_walker_skips(tmp_path):
     w = FSWalker(WalkOption(skip_dirs=["skipme"]))
     seen = [rel for rel, _, _ in w.walk(str(tmp_path))]
     assert seen == ["keep/a.txt"]
+
+
+def test_repo_command_local_bare_url(tmp_path):
+    """repo command clones a git URL (local bare repo as the no-egress
+    stand-in, ref: internal/gittest/server.go technique) and scans the
+    checkout."""
+    import subprocess
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "config.py").write_text('key = "AKIAQWERTYUIOPASDFGHJK"\n')
+    env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t",
+           "PATH": __import__("os").environ["PATH"], "HOME": str(tmp_path)}
+    run = lambda *a, **kw: subprocess.run(a, check=True, capture_output=True, env=env, **kw)  # noqa: E731
+    run("git", "init", "-q", "-b", "main", str(src))
+    run("git", "-C", str(src), "add", "-A")
+    run("git", "-C", str(src), "commit", "-q", "-m", "x")
+    bare = tmp_path / "repo.git"
+    run("git", "clone", "-q", "--bare", str(src), str(bare))
+
+    p = run_cli(
+        "repo", "--scanners", "secret", "--backend", "cpu", "--format", "json",
+        "--branch", "main", "--cache-dir", str(tmp_path / "cache"),
+        f"file://{bare}",
+    )
+    doc = json.loads(p.stdout)
+    ids = [s["RuleID"] for r in doc["Results"] for s in r.get("Secrets", [])]
+    assert ids == ["aws-access-key-id"]

@@ -163,3 +163,110 @@ def test_cli_image_scan(tmp_path):
     doc = json.loads(p.stdout)
     assert doc["ArtifactType"] == "container_image"
     assert "/new/cred.txt" in {r["Target"] for r in doc["Results"]}
+
+
+def test_base_layer_indices():
+    from trivy_tpu.artifact.image import _base_layer_indices
+
+    hist = [
+        {"created_by": "/bin/sh -c #(nop) ADD file:x in / ", "empty_layer": False},
+        {"created_by": '/bin/sh -c #(nop)  CMD ["bash"]', "empty_layer": True},
+        {"created_by": "RUN /bin/sh -c apt-get update", "empty_layer": False},
+        {"created_by": "COPY app /app", "empty_layer": False},
+        {"created_by": 'CMD ["/app"]', "empty_layer": True},
+    ]
+    assert _base_layer_indices(hist) == {0}
+
+
+def test_base_layer_secret_skip(tmp_path):
+    """Secrets in base-image layers are skipped; app-layer secrets are
+    found (ref: image.go:209-213)."""
+    from tests.imagetest import docker_save_tar, tar_bytes
+    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    secret_line = b'key = "AKIAQWERTYUIOPASDFGHJK"\n'
+    base_layer = tar_bytes({"etc/base.conf": secret_line})
+    app_layer = tar_bytes({"app/app.conf": secret_line})
+    history = [
+        {"created_by": "/bin/sh -c #(nop) ADD file:abc in / ", "empty_layer": False},
+        {"created_by": '/bin/sh -c #(nop)  CMD ["sh"]', "empty_layer": True},
+        {"created_by": "COPY app /app", "empty_layer": False},
+    ]
+    archive = tmp_path / "img.tar"
+    docker_save_tar(str(archive), [base_layer, app_layer], history=history)
+    cache = new_cache("memory", None)
+    art = ImageArchiveArtifact(str(archive), cache, ArtifactOption(backend="cpu"))
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=["secret"])
+    )
+    targets = {r.target for r in report.results for s in r.secrets}
+    assert any("app/app.conf" in t for t in targets)
+    assert not any("base.conf" in t for t in targets)
+
+
+def test_parallel_layer_analysis(tmp_path):
+    """Many missing layers analyze concurrently with identical results."""
+    from tests.imagetest import docker_save_tar, tar_bytes
+    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    layers = [
+        tar_bytes({f"opt/f{i}.txt": f'k{i} = "AKIAQWERTYUIOPASDFGHJK"\n'.encode()})
+        for i in range(6)
+    ]
+    archive = tmp_path / "img.tar"
+    docker_save_tar(str(archive), layers)
+    cache = new_cache("memory", None)
+    art = ImageArchiveArtifact(
+        str(archive), cache, ArtifactOption(backend="cpu", parallel=4)
+    )
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=["secret"])
+    )
+    found = sorted(
+        s.rule_id for r in report.results for s in r.secrets
+    )
+    assert found == ["aws-access-key-id"] * 6
+
+
+def test_base_layer_cache_key_differs(tmp_path):
+    """A layer cached as a base layer (secret-skipped) must not satisfy a
+    scan where the same diff-ID is the app layer (cache-poisoning guard)."""
+    from tests.imagetest import docker_save_tar, tar_bytes
+    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    secret_layer = tar_bytes({"etc/s.conf": b'key = "AKIAQWERTYUIOPASDFGHJK"\n'})
+    app_layer = tar_bytes({"app/x.txt": b"hello\n"})
+    cache = new_cache("memory", None)
+
+    # image A: secret layer is the BASE (followed by CMD + app layer)
+    hist_a = [
+        {"created_by": "ADD file:x in /", "empty_layer": False},
+        {"created_by": '/bin/sh -c #(nop)  CMD ["sh"]', "empty_layer": True},
+        {"created_by": "COPY app /app", "empty_layer": False},
+    ]
+    img_a = tmp_path / "a.tar"
+    docker_save_tar(str(img_a), [secret_layer, app_layer], history=hist_a)
+    art = ImageArchiveArtifact(str(img_a), cache, ArtifactOption(backend="cpu"))
+    rep_a = Scanner(art, LocalDriver(cache)).scan_artifact(ScanOptions(scanners=["secret"]))
+    assert not any(s for r in rep_a.results for s in r.secrets)
+
+    # image B: the SAME secret layer is the only (app) layer — must rescan
+    img_b = tmp_path / "b.tar"
+    docker_save_tar(str(img_b), [secret_layer],
+                    history=[{"created_by": "COPY . /", "empty_layer": False}])
+    art = ImageArchiveArtifact(str(img_b), cache, ArtifactOption(backend="cpu"))
+    rep_b = Scanner(art, LocalDriver(cache)).scan_artifact(ScanOptions(scanners=["secret"]))
+    assert any(s.rule_id == "aws-access-key-id"
+               for r in rep_b.results for s in r.secrets)

@@ -164,3 +164,75 @@ def test_secret_scanning_stays_client_side(server, tmp_path):
     )
     assert [r.target for r in report.results] == ["cred.txt"]
     assert report.results[0].secrets[0].rule_id == "github-pat"
+
+
+def test_db_reload_swaps_advisories(tmp_path):
+    """Server DB hot-swap with in-flight serialization (ref:
+    pkg/rpc/server/listen.go:62-80): a reload picks up new advisories
+    without restarting the server."""
+    import json as _json
+
+    from trivy_tpu.db import VulnDB
+    from trivy_tpu.rpc.server import DBReloader, ScanServer
+    from trivy_tpu.cache import new_cache
+
+    dbdir = tmp_path / "db"
+    dbdir.mkdir()
+    (dbdir / "advisories.json").write_text(_json.dumps({
+        "npm::test": {"lodash": [
+            {"VulnerabilityID": "CVE-OLD", "VulnerableVersions": ["<5.0.0"]},
+        ]},
+    }))
+    server = ScanServer(new_cache("memory", None), vuln_client=VulnDB.load(str(dbdir)))
+    reloader = DBReloader(server, str(dbdir), interval=9999)
+    server.reloader = reloader
+
+    (dbdir / "advisories.json").write_text(_json.dumps({
+        "npm::test": {"lodash": [
+            {"VulnerabilityID": "CVE-NEW", "VulnerableVersions": ["<5.0.0"]},
+        ]},
+    }))
+    reloader.request_begin()   # a request is mid-flight
+    import threading
+
+    done = threading.Event()
+    threading.Thread(target=lambda: (reloader.reload(), done.set()), daemon=True).start()
+    assert not done.wait(0.3), "reload must wait for in-flight requests"
+    reloader.request_end()
+    assert done.wait(5), "reload must complete once requests drain"
+    advs = server.driver.vuln_client.get_advisories("npm::test", "lodash")
+    assert [a.vulnerability_id for a in advs] == ["CVE-NEW"]
+
+
+def test_stale_db_warning(tmp_path, caplog):
+    import json as _json
+
+    from trivy_tpu.db import load_default_db
+
+    dbdir = tmp_path / "db"
+    dbdir.mkdir()
+    (dbdir / "advisories.json").write_text("{}")
+    (dbdir / "metadata.json").write_text(_json.dumps({
+        "Version": 2, "NextUpdate": "2020-01-01T00:00:00Z",
+    }))
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        db = load_default_db(str(dbdir), None)
+    assert db is not None and db.is_stale()
+    assert any("stale" in r.message for r in caplog.records)
+
+
+def test_fresh_db_no_warning(tmp_path):
+    import json as _json
+
+    from trivy_tpu.db import load_default_db
+
+    dbdir = tmp_path / "db"
+    dbdir.mkdir()
+    (dbdir / "advisories.json").write_text("{}")
+    (dbdir / "metadata.json").write_text(_json.dumps({
+        "Version": 2, "NextUpdate": "2999-01-01T00:00:00Z",
+    }))
+    db = load_default_db(str(dbdir), None)
+    assert db is not None and not db.is_stale()

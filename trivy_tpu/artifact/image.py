@@ -168,27 +168,59 @@ class ImageArchiveArtifact:
 
     # -- per-layer analysis --------------------------------------------------
 
-    def _analyze_layer(self, archive: _ImageArchive, index: int,
-                       diff_id: str, created_by: str) -> BlobInfo:
-        result = AnalysisResult()
-        post_files: dict = {}
-        layer_res = LayerResult()
-        stream = archive.layer_stream(index)
+    def _layer_group(self, skip_secret: bool) -> AnalyzerGroup:
+        """A fresh analyzer group per layer: batched analyzers are stateful,
+        so concurrent layers must not share one (the reference's layer
+        pipeline gets the same isolation from goroutine-local state)."""
+        disabled = list(self.option.disabled_analyzers)
+        if skip_secret:
+            from trivy_tpu.fanal.analyzer import AnalyzerType
+
+            disabled.append(AnalyzerType.SECRET)
+        return AnalyzerGroup(
+            AnalyzerOptions(
+                disabled=disabled,
+                secret_config_path=self.option.secret_config_path,
+                backend=self.option.backend,
+                extra=self.option.analyzer_extra,
+            )
+        )
+
+    def _analyze_layer(self, index: int, diff_id: str, created_by: str,
+                       skip_secret: bool = False, archive=None,
+                       group=None) -> BlobInfo:
+        """Analyze one layer. Without ``archive``/``group`` it opens its own
+        handle and group — safe to run concurrently (tarfile handles are
+        not thread-safe, batched analyzers are stateful); the serial caller
+        passes shared ones to avoid per-layer reopen/rebuild."""
+        own_archive = archive is None
+        if own_archive:
+            archive = _ImageArchive(self.path)
+        if group is None:
+            group = self._layer_group(skip_secret)
         try:
-            for rel, info, opener in self.walker.walk(stream, layer_res):
-                wanted = self.group.analyze_file(result, "", rel, info, opener)
-                for t, content in wanted.items():
-                    post_files.setdefault(t, {})[rel] = content
+            result = AnalysisResult()
+            post_files: dict = {}
+            layer_res = LayerResult()
+            stream = archive.layer_stream(index)
+            try:
+                for rel, info, opener in self.walker.walk(stream, layer_res):
+                    wanted = group.analyze_file(result, "", rel, info, opener)
+                    for t, content in wanted.items():
+                        post_files.setdefault(t, {})[rel] = content
+            finally:
+                stream.close()
+            group.finalize(result, post_files)
+            blob = result.to_blob_info()
+            self.handlers.post_handle(result, blob)
+            blob.diff_id = diff_id
+            blob.created_by = created_by
+            blob.whiteout_files = sorted(layer_res.whiteout_files)
+            blob.opaque_dirs = sorted(layer_res.opaque_dirs)
+            return blob
         finally:
-            stream.close()
-        self.group.finalize(result, post_files)
-        blob = result.to_blob_info()
-        self.handlers.post_handle(result, blob)
-        blob.diff_id = diff_id
-        blob.created_by = created_by
-        blob.whiteout_files = sorted(layer_res.whiteout_files)
-        blob.opaque_dirs = sorted(layer_res.opaque_dirs)
-        return blob
+            if own_archive:
+                archive.close()
 
     def _analyze_config(self, archive: _ImageArchive) -> BlobInfo:
         """Image-config analysis as a synthetic top blob (imgconf analog)."""
@@ -217,21 +249,55 @@ class ImageArchiveArtifact:
                     skip_dirs=self.option.skip_dirs,
                 )
 
-            layer_keys = [key(d) for d in diff_ids]
+            base_layers = _base_layer_indices(archive.config.get("history", []))
+            # the per-layer analyzer set is part of the key: a base layer is
+            # analyzed without the secret analyzer, and that blob must never
+            # satisfy a scan where the same diff-ID is NOT a base layer
+            # (ref: image.go calcKeys appends the per-layer disabled list)
+            layer_keys = [
+                key(d + ("/secret-skipped" if i in base_layers else ""))
+                for i, d in enumerate(diff_ids)
+            ]
             config_key = key(archive.image_id + "/config")
             blob_ids = layer_keys + [config_key]
             artifact_key = key(archive.image_id)
 
             _, missing = self.cache.missing_blobs(artifact_key, blob_ids)
             missing_set = set(missing)
+            todo = []
             for i, (diff_id, lkey) in enumerate(zip(diff_ids, layer_keys)):
                 if lkey not in missing_set:
                     continue
                 created_by = (
                     history[i].get("created_by", "") if i < len(history) else ""
                 )
-                blob = self._analyze_layer(archive, i, diff_id, created_by)
-                self.cache.put_blob(lkey, blob.to_dict())
+                # base-image layers skip secret scanning (their secrets are
+                # the base maintainer's problem; ref: image.go:209-213)
+                todo.append((i, diff_id, lkey, created_by, i in base_layers))
+            # layer-parallel analysis (ref: image.go:205-231 parallel.Pipeline)
+            workers = min(len(todo), self.option.parallel or 4)
+            if workers > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    futs = [
+                        (lkey, pool.submit(
+                            self._analyze_layer, i, diff_id, created_by, skip
+                        ))
+                        for i, diff_id, lkey, created_by, skip in todo
+                    ]
+                    for lkey, fut in futs:
+                        self.cache.put_blob(lkey, fut.result().to_dict())
+            else:
+                groups: dict[bool, AnalyzerGroup] = {}
+                for i, diff_id, lkey, created_by, skip in todo:
+                    if skip not in groups:
+                        groups[skip] = self._layer_group(skip)
+                    blob = self._analyze_layer(
+                        i, diff_id, created_by, skip,
+                        archive=archive, group=groups[skip],
+                    )
+                    self.cache.put_blob(lkey, blob.to_dict())
             if config_key in missing_set:
                 blob = self._analyze_config(archive)
                 self.cache.put_blob(config_key, blob.to_dict())
@@ -255,3 +321,36 @@ class ImageArchiveArtifact:
             )
         finally:
             archive.close()
+
+
+def _base_layer_indices(histories: list[dict]) -> set[int]:
+    """Indices (in layer order) of layers that belong to the base image
+    (ref: pkg/fanal/image/image.go:111-137 GuessBaseImageIndex): walking
+    history backwards, the base image ends at the last empty-layer CMD
+    entry before the final non-empty instruction."""
+    base_history_idx = -1
+    found_non_empty = False
+    for i in range(len(histories) - 1, -1, -1):
+        h = histories[i]
+        empty = bool(h.get("empty_layer"))
+        if not found_non_empty:
+            if empty:
+                continue
+            found_non_empty = True
+        if not empty:
+            continue
+        created_by = h.get("created_by", "")
+        if created_by.startswith(("/bin/sh -c #(nop)  CMD", "CMD")):
+            base_history_idx = i
+            break
+    if base_history_idx < 0:
+        return set()
+    # map history index -> layer index (only non-empty entries have layers)
+    out = set()
+    layer = 0
+    for i, h in enumerate(histories):
+        if not h.get("empty_layer"):
+            if i <= base_history_idx:
+                out.add(layer)
+            layer += 1
+    return out

@@ -203,6 +203,11 @@ def build_parser() -> argparse.ArgumentParser:
         elif cmd == "clean":
             p.add_argument("--all", action="store_true", dest="clean_all")
             p.add_argument("--scan-cache", action="store_true")
+        elif cmd == "repo":
+            p.add_argument("--branch", default=None, help="branch to check out")
+            p.add_argument("--tag", default=None, help="tag to check out")
+            p.add_argument("--commit", default=None, help="commit to check out")
+            p.add_argument("target", help="repository path or URL")
         elif cmd == "image":
             # ref: trivy image --input for archives; positional for names
             p.add_argument("--input", default=None,

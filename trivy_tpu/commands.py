@@ -176,11 +176,21 @@ def _run_fs_like(command: str, ns, opts) -> int:
     art_opt = _artifact_option(ns, opts)
 
     if command == "repo" and (
-        target.startswith(("http://", "https://", "git://")) or target.endswith(".git")
+        target.startswith(("http://", "https://", "git://", "file://", "ssh://"))
+        or target.endswith(".git")
     ):
-        from trivy_tpu.artifact.repo import checkout_repo
+        from trivy_tpu.artifact.repo import RepoError, checkout_repo
 
-        target = checkout_repo(target)
+        try:
+            target = checkout_repo(
+                target,
+                branch=getattr(ns, "branch", None),
+                tag=getattr(ns, "tag", None),
+                commit=getattr(ns, "commit", None),
+            )
+        except RepoError as e:
+            logger.error("%s", e)
+            return 1
 
     server = opts.get("server")
     if server:

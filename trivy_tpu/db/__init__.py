@@ -71,6 +71,7 @@ class VulnDB:
         self.buckets = buckets
         self.details = details
         self.metadata = metadata or {}
+        self.db_dir = ""  # source directory, when loaded from disk
         self._prefix_index: dict[str, list[str]] = {}
 
     # -- advisory lookup ----------------------------------------------------
@@ -90,6 +91,34 @@ class VulnDB:
 
     def get_detail(self, vuln_id: str) -> dict:
         return self.details.get(vuln_id, {})
+
+    # -- freshness (ref: pkg/db/db.go:98-140 NeedsUpdate/validate) ----------
+
+    def next_update(self):
+        """metadata NextUpdate as an aware datetime, or None."""
+        import datetime
+
+        raw = self.metadata.get("NextUpdate")
+        if not raw:
+            return None
+        try:
+            return datetime.datetime.fromisoformat(str(raw).replace("Z", "+00:00"))
+        except ValueError:
+            return None
+
+    def is_stale(self, now=None) -> bool:
+        """True when metadata says a newer DB should exist (NextUpdate in
+        the past). A DB without metadata is never 'stale' — fixture DBs
+        carry no freshness contract."""
+        import datetime
+
+        nu = self.next_update()
+        if nu is None:
+            return False
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        if nu.tzinfo is None:
+            nu = nu.replace(tzinfo=datetime.timezone.utc)
+        return nu < now
 
     # -- loading ------------------------------------------------------------
 
@@ -149,5 +178,16 @@ def load_default_db(db_repository: str | None, cache_dir: str | None) -> VulnDB 
             os.path.exists(os.path.join(cand, "advisories.json"))
             or os.path.isdir(os.path.join(cand, "advisories"))
         ):
-            return VulnDB.load(cand)
+            db = VulnDB.load(cand)
+            if db.is_stale():
+                # a stale DB still scans — but silently missing the newest
+                # advisories is worse than a loud warning
+                # (ref: pkg/db/db.go NeedsUpdate NextUpdate check)
+                logger.warning(
+                    "advisory DB at %s is stale (NextUpdate %s has passed); "
+                    "results may miss recent vulnerabilities",
+                    cand, db.metadata.get("NextUpdate"),
+                )
+            db.db_dir = cand
+            return db
     return None

@@ -23,6 +23,62 @@ logger = log.logger("rpc:server")
 MAX_REQUEST_BYTES = 256 * 1024 * 1024
 
 
+class DBReloader:
+    """Periodic advisory-DB hot swap with in-flight serialization
+    (ref: pkg/rpc/server/listen.go:62-80 — the hourly updater waits for
+    in-flight requests via paired WaitGroups; here one Condition carries
+    both roles: requests wait while a swap runs, the swap waits for the
+    in-flight count to drain)."""
+
+    def __init__(self, server: "ScanServer", db_dir: str, interval: float = 3600.0):
+        self.server = server
+        self.db_dir = db_dir
+        self.interval = interval
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._updating = False
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.reload()
+            except Exception as e:
+                logger.warning("DB reload failed (keeping current DB): %s", e)
+
+    def reload(self) -> None:
+        """Load the DB fresh, then swap it in once no request is mid-scan."""
+        from trivy_tpu.db import VulnDB
+
+        new_db = VulnDB.load(self.db_dir)  # load OUTSIDE the lock
+        new_db.db_dir = self.db_dir
+        with self._cond:
+            self._updating = True
+            while self._inflight > 0:
+                self._cond.wait()
+            self.server.driver.vuln_client = new_db
+            self._updating = False
+            self._cond.notify_all()
+        logger.info("advisory DB reloaded from %s", self.db_dir)
+
+    def request_begin(self) -> None:
+        with self._cond:
+            while self._updating:
+                self._cond.wait()
+            self._inflight += 1
+
+    def request_end(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+
 class ScanServer:
     """Service implementation bound to a cache and a local driver."""
 
@@ -31,6 +87,7 @@ class ScanServer:
 
         self.cache = cache
         self.driver = LocalDriver(cache, vuln_client=vuln_client)
+        self.reloader: DBReloader | None = None
 
     # -- service methods (JSON dict in/out) ---------------------------------
 
@@ -129,7 +186,14 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                     self._reply(413, {"error": "request too large"})
                     return
                 req = json.loads(self.rfile.read(length) or b"{}")
-                resp = getattr(server, method)(req)
+                reloader = server.reloader
+                if reloader is not None:
+                    reloader.request_begin()
+                try:
+                    resp = getattr(server, method)(req)
+                finally:
+                    if reloader is not None:
+                        reloader.request_end()
                 self._reply(200, resp)
             except KeyError as e:
                 self._reply(400, {"error": f"bad request: {e}"})
@@ -148,15 +212,22 @@ def start_server(
     vuln_client=None,
     token: str = "",
     token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+    db_reload_dir: str | None = None,
+    db_reload_interval: float = 3600.0,
 ):
     """Start the server on a background thread; returns (httpd, actual_port).
     port=0 picks a free port — the reference's own client/server tests use
-    exactly this in-process technique (ref: integration/client_server_test.go)."""
+    exactly this in-process technique (ref: integration/client_server_test.go).
+    With ``db_reload_dir``, an hourly worker hot-swaps the advisory DB
+    (ref: listen.go:62-80)."""
     if cache is None:
         from trivy_tpu.cache import new_cache
 
         cache = new_cache("fs", cache_dir)
     service = ScanServer(cache, vuln_client=vuln_client)
+    if db_reload_dir:
+        service.reloader = DBReloader(service, db_reload_dir, db_reload_interval)
+        service.reloader.start()
     httpd = ThreadingHTTPServer(
         (host, port), _make_handler(service, token, token_header)
     )
@@ -177,6 +248,7 @@ def serve(host: str, port: int, cache_dir: str | None = None,
     httpd, actual = start_server(
         host, port, cache_dir=cache_dir, vuln_client=vuln_client,
         token=token, token_header=token_header,
+        db_reload_dir=getattr(vuln_client, "db_dir", "") or None,
     )
     logger.info("listening on %s:%d", host, actual)
     try:
