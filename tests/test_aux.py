@@ -1,0 +1,195 @@
+"""Aux subsystem tests: post-scan hooks, tracing, compliance, plugins
+(ref: pkg/scanner/post, pkg/compliance, pkg/plugin tests)."""
+
+from __future__ import annotations
+
+import io
+
+import pytest
+
+from trivy_tpu import plugin, trace
+from trivy_tpu.compliance import apply_spec, load_spec, write_report
+from trivy_tpu.scanner.post import (
+    PostScanner,
+    deregister_post_scanner,
+    post_scan,
+    register_post_scanner,
+    scanner_versions,
+)
+from trivy_tpu.types import MisconfResult, Report, Result
+
+
+class TestPostScan:
+    def test_hook_rewrites_results(self):
+        class Dropper(PostScanner):
+            name = "dropper"
+            version = 3
+
+            def post_scan(self, results):
+                return [r for r in results if r.target != "drop-me"]
+
+        register_post_scanner(Dropper())
+        try:
+            assert scanner_versions() == {"dropper": 3}
+            out = post_scan([Result(target="drop-me"), Result(target="keep")])
+            assert [r.target for r in out] == ["keep"]
+        finally:
+            deregister_post_scanner("dropper")
+
+    def test_hook_error_not_fatal(self):
+        class Boom(PostScanner):
+            name = "boom"
+
+            def post_scan(self, results):
+                raise RuntimeError("x")
+
+        register_post_scanner(Boom())
+        try:
+            out = post_scan([Result(target="t")])
+            assert [r.target for r in out] == ["t"]
+        finally:
+            deregister_post_scanner("boom")
+
+    def test_driver_runs_hooks(self):
+        from trivy_tpu.cache import new_cache
+        from trivy_tpu.scanner import ScanOptions
+        from trivy_tpu.scanner.local_driver import LocalDriver
+        from trivy_tpu.types import BlobInfo
+
+        class Tagger(PostScanner):
+            name = "tagger"
+
+            def post_scan(self, results):
+                for r in results:
+                    r.target = "tagged:" + r.target
+                return results
+
+        cache = new_cache("memory", None)
+        cache.put_blob("b1", BlobInfo(
+            secrets=[],
+        ).to_dict())
+        register_post_scanner(Tagger())
+        try:
+            driver = LocalDriver(cache)
+            results, _ = driver.scan("t", "a1", ["b1"], ScanOptions(scanners=["secret"]))
+            assert all(r.target.startswith("tagged:") for r in results)
+        finally:
+            deregister_post_scanner("tagger")
+
+
+class TestTrace:
+    def test_spans_report(self):
+        trace.reset()
+        trace.enable()
+        with trace.span("unit.test.span"):
+            pass
+        trace.add("unit.test.add", 0.5)
+        buf = io.StringIO()
+        trace.report(buf)
+        out = buf.getvalue()
+        assert "unit.test.span" in out and "unit.test.add" in out
+        trace.reset()
+
+
+class TestCompliance:
+    def test_builtin_spec_pass_fail(self):
+        report = Report(results=[Result(
+            target="d.yaml", cls="config",
+            misconfigurations=[
+                MisconfResult(status="FAIL", id="KSV017", avd_id="AVD-KSV-0017"),
+                MisconfResult(status="PASS", id="KSV012", avd_id="AVD-KSV-0012"),
+            ],
+        )])
+        creport = apply_spec(load_spec("k8s-nsa-1.0"), report)
+        by_id = {r.control.id: r for r in creport.results}
+        assert by_id["1.2"].status == "FAIL"       # privileged: KSV017 failed
+        assert by_id["1.0"].status == "PASS"       # non-root: KSV012 passed
+        assert by_id["2.0"].status == "MANUAL"
+        assert creport.summary["FAIL"] == 1
+
+    def test_custom_spec_file(self, tmp_path):
+        spec_yaml = """\
+spec:
+  id: my-spec
+  title: My Spec
+  controls:
+    - id: C1
+      name: no privileged pods
+      severity: HIGH
+      checks:
+        - id: KSV017
+"""
+        p = tmp_path / "spec.yaml"
+        p.write_text(spec_yaml)
+        spec = load_spec(f"@{p}")
+        assert spec.id == "my-spec"
+        assert spec.controls[0].checks == ["KSV017"]
+
+    def test_unknown_spec(self):
+        with pytest.raises(ValueError):
+            load_spec("nope")
+
+    def test_report_renderers(self):
+        creport = apply_spec(load_spec("docker-cis-1.6.0"), Report(results=[]))
+        table = io.StringIO()
+        write_report(creport, table, "table")
+        assert "CIS Docker" in table.getvalue()
+        import json
+
+        jout = io.StringIO()
+        write_report(creport, jout, "json")
+        doc = json.loads(jout.getvalue())
+        assert doc["ID"] == "docker-cis-1.6.0"
+        assert all(r["Status"] in ("PASS", "FAIL", "MANUAL") for r in doc["Results"])
+
+
+@pytest.fixture
+def plugin_src(tmp_path):
+    src = tmp_path / "hello"
+    src.mkdir()
+    (src / "plugin.yaml").write_text(
+        "name: hello\nversion: 1.0.0\nsummary: say hello\n"
+        "platforms:\n  - bin: ./hello.sh\n"
+    )
+    binf = src / "hello.sh"
+    binf.write_text("#!/bin/sh\necho hello-from-plugin $1\nexit 7\n")
+    binf.chmod(0o755)
+    return src
+
+
+class TestPlugin:
+    def test_install_list_run_uninstall(self, tmp_path, plugin_src, capfd):
+        root = str(tmp_path / "plugins")
+        manifest = plugin.install(str(plugin_src), root=root)
+        assert manifest["name"] == "hello"
+        assert [m["name"] for m in plugin.list_installed(root=root)] == ["hello"]
+        rc = plugin.run("hello", ["world"], root=root)
+        assert rc == 7
+        assert "hello-from-plugin world" in capfd.readouterr().out
+        assert plugin.uninstall("hello", root=root)
+        assert plugin.list_installed(root=root) == []
+
+    def test_install_archive(self, tmp_path, plugin_src):
+        import tarfile
+
+        archive = tmp_path / "hello.tar.gz"
+        with tarfile.open(archive, "w:gz") as tf:
+            tf.add(plugin_src, arcname="hello")
+        root = str(tmp_path / "plugins2")
+        manifest = plugin.install(str(archive), root=root)
+        assert manifest["name"] == "hello"
+        assert plugin.run("hello", [], root=root) == 7
+
+    def test_missing_plugin(self, tmp_path):
+        with pytest.raises(plugin.PluginError):
+            plugin.run("ghost", [], root=str(tmp_path / "empty"))
+
+    def test_platform_selector_mismatch(self, tmp_path, plugin_src):
+        (plugin_src / "plugin.yaml").write_text(
+            "name: hello\nversion: 1.0.0\n"
+            "platforms:\n  - selector: {os: plan9}\n    bin: ./hello.sh\n"
+        )
+        root = str(tmp_path / "plugins3")
+        plugin.install(str(plugin_src), root=root)
+        with pytest.raises(plugin.PluginError):
+            plugin.run("hello", [], root=root)

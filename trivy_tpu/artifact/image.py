@@ -153,14 +153,9 @@ class ImageArchiveArtifact:
         self.path = path
         self.cache = cache
         self.option = option or ArtifactOption()
-        self.group = AnalyzerGroup(
-            AnalyzerOptions(
-                disabled=self.option.disabled_analyzers,
-                secret_config_path=self.option.secret_config_path,
-                backend=self.option.backend,
-                extra=self.option.analyzer_extra,
-            )
-        )
+        # one construction site: _layer_group owns the option mapping, and
+        # this instance only serves versions() for cache keys
+        self.group = self._layer_group(False)
         self.handlers = HandlerManager()
         self.walker = LayerTarWalker(
             skip_files=self.option.skip_files, skip_dirs=self.option.skip_dirs

@@ -63,7 +63,7 @@ def checkout_repo(
         args += ["--branch", ref]
     if not commit:
         args += ["--depth", "1"]
-    args += [url, tmp]
+    args += ["--", url, tmp]
     try:
         _git(args)
         if commit:

@@ -34,6 +34,8 @@ def global_flags() -> FlagGroup:
             Flag("config", default=None, help="config file path", short="c"),
             Flag("timeout", default=300, value_type=int, config_name="timeout",
                  help="scan timeout seconds (ref default 5m)"),
+            Flag("trace", default=False, value_type=bool, config_name="trace",
+                 help="print per-stage timing spans after the scan"),
         ],
     )
 
@@ -84,6 +86,9 @@ def report_flags() -> FlagGroup:
                  help="go-template style output template (for --format template)"),
             Flag("list-all-pkgs", default=False, value_type=bool,
                  config_name="list-all-pkgs", help="include all packages in report"),
+            Flag("compliance", default=None, config_name="compliance",
+                 help="render a compliance report (docker-cis-1.6.0, "
+                      "k8s-nsa-1.0, or @spec.yaml)"),
         ],
     )
 
@@ -217,6 +222,15 @@ def build_parser() -> argparse.ArgumentParser:
         else:
             p.add_argument("target", help="scan target")
 
+    pp = sub.add_parser("plugin", help="manage plugins (install/list/run/uninstall)")
+    psub = pp.add_subparsers(dest="plugin_cmd")
+    pi = psub.add_parser("install"); pi.add_argument("source")
+    psub.add_parser("list")
+    pu = psub.add_parser("uninstall"); pu.add_argument("name")
+    pr = psub.add_parser("run")
+    pr.add_argument("name")
+    pr.add_argument("plugin_args", nargs=argparse.REMAINDER)
+
     vp = sub.add_parser("version", help="print version")
     vp.add_argument("--format", default="text", choices=["text", "json"])
     parser._groups_by_cmd = groups_by_cmd  # type: ignore[attr-defined]
@@ -237,6 +251,30 @@ def main(argv: list[str] | None = None) -> int:
         else:
             print(f"trivy-tpu version {VERSION}")
         return 0
+    if ns.command == "plugin":
+        from trivy_tpu import plugin
+
+        try:
+            if ns.plugin_cmd == "install":
+                manifest = plugin.install(ns.source)
+                print(f"installed {manifest['name']} {manifest.get('version', '')}")
+            elif ns.plugin_cmd == "list":
+                for m in plugin.list_installed():
+                    print(f"{m['name']}\t{m.get('version', '')}\t{m.get('summary', '')}")
+            elif ns.plugin_cmd == "uninstall":
+                ok = plugin.uninstall(ns.name)
+                print("removed" if ok else f"{ns.name} is not installed")
+            elif ns.plugin_cmd == "run":
+                return plugin.run(ns.name, list(ns.plugin_args or []))
+            else:
+                parser.parse_args(["plugin", "--help"])
+            return 0
+        except plugin.PluginError as e:
+            log.logger("cli").error("%s", e)
+            return 1
+        except OSError as e:  # unreadable archive, non-executable bin, ...
+            log.logger("cli").error("plugin %s failed: %s", ns.plugin_cmd, e)
+            return 1
 
     groups = parser._groups_by_cmd[ns.command]  # type: ignore[attr-defined]
     try:

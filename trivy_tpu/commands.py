@@ -102,6 +102,10 @@ def run(command: str, ns, opts) -> int:
         signal.alarm(timeout)
     from trivy_tpu.result import IgnorePolicy, PolicyError
 
+    from trivy_tpu import trace
+
+    if opts.get("trace"):
+        trace.enable()
     try:
         # validate the ignore policy up front: a broken policy file must not
         # cost the user a full scan before failing
@@ -136,6 +140,7 @@ def run(command: str, ns, opts) -> int:
     finally:
         if timeout > 0 and command != "server":
             signal.alarm(0)
+        trace.report()
 
 
 def _emit(report, ns, opts) -> int:
@@ -152,6 +157,32 @@ def _emit(report, ns, opts) -> int:
             show_suppressed=bool(opts.get("show_suppressed")),
         ),
     )
+    compliance = opts.get("compliance")
+    if compliance:
+        from trivy_tpu.compliance import apply_spec, load_spec, write_report
+
+        fmt = opts.get("format", "table")
+        if fmt not in ("table", "json"):
+            logger.error(
+                "--compliance supports only table and json output, not %s", fmt
+            )
+            return 2
+        try:
+            spec = load_spec(compliance)
+        except (ValueError, OSError) as e:
+            logger.error("%s", e)
+            return 2
+        creport = apply_spec(spec, report)
+        output = opts.get("output")
+        if output:
+            with open(output, "w") as f:
+                write_report(creport, f, fmt)
+        else:
+            write_report(creport, sys.stdout, fmt)
+        exit_code = opts.get("exit_code", 0)
+        if exit_code and any(r.status == "FAIL" for r in creport.results):
+            return exit_code
+        return 0
     output = opts.get("output")
     kw = {}
     if opts.get("template"):

@@ -64,6 +64,12 @@ class MisconfScanner:
         return c.id not in self._disabled and c.avd_id not in self._disabled
 
     def scan_files(self, files: list[tuple[str, bytes]]) -> list[Misconfiguration]:
+        from trivy_tpu import trace
+
+        with trace.span("misconf.scan_files"):
+            return self._scan_files(files)
+
+    def _scan_files(self, files: list[tuple[str, bytes]]) -> list[Misconfiguration]:
         tf_files: dict[str, bytes] = {}
         helm_files: dict[str, bytes] = {}
         per_file: list[tuple[str, str, bytes]] = []

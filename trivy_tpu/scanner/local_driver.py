@@ -50,6 +50,10 @@ class LocalDriver:
             results.extend(self._secret_results(detail))
         if "license" in options.scanners:
             results.extend(self._license_results(target, detail, options))
+        # post-scan hooks may rewrite the result list (ref: local/scan.go:145)
+        from trivy_tpu.scanner.post import post_scan
+
+        results = post_scan(results)
         return results, detail.os
 
     # -- per-class assembly (ref: scan.go:153-318) --------------------------

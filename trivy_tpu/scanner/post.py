@@ -1,0 +1,49 @@
+"""Post-scan hook registry (ref: pkg/scanner/post/post_scan.go).
+
+Post scanners run after result assembly and may rewrite the result list —
+the extension seam WASM modules and plugins use in the reference
+(ref: pkg/module/module.go:417). Versions feed cache keys like analyzer
+versions do.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu import log
+
+logger = log.logger("scanner:post")
+
+_post_scanners: dict[str, object] = {}
+
+
+class PostScanner:
+    """Interface: subclass with name/version attrs and post_scan()."""
+
+    name: str = ""
+    version: int = 1
+
+    def post_scan(self, results: list) -> list:  # pragma: no cover - iface
+        return results
+
+
+def register_post_scanner(scanner: PostScanner) -> None:
+    _post_scanners[scanner.name] = scanner
+
+
+def deregister_post_scanner(name: str) -> None:
+    _post_scanners.pop(name, None)
+
+
+def scanner_versions() -> dict[str, int]:
+    return {name: s.version for name, s in sorted(_post_scanners.items())}
+
+
+def post_scan(results: list) -> list:
+    """Run every registered post scanner in name order (deterministic —
+    the reference iterates a map; sorted order is strictly better)."""
+    for name in sorted(_post_scanners):
+        try:
+            results = _post_scanners[name].post_scan(results)
+        except Exception as e:
+            # hooks must not kill a scan (analyzer-error policy applies)
+            logger.warning("post scanner %s failed: %s", name, e)
+    return results

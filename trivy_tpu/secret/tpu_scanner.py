@@ -31,7 +31,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from trivy_tpu import log
+from trivy_tpu import log, trace
 from trivy_tpu.ops.match import build_match_fn
 from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
 from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
@@ -186,7 +186,8 @@ class TpuSecretScanner:
             if not meta:
                 return
             n = next(b for b in self._buckets if b >= len(meta))
-            dev = self._match(buf[:n])  # async dispatch, fixed bucket shape
+            with trace.span("secret.dispatch"):
+                dev = self._match(buf[:n])  # async dispatch, fixed bucket shape
             inflight.append((dev, meta))
             meta = []
             # rotate to the next ring buffer; full rows are overwritten on
@@ -197,12 +198,16 @@ class TpuSecretScanner:
             buf = bufs[buf_i]
             while len(inflight) >= PIPELINE_DEPTH:
                 d, m = inflight.popleft()
-                resolve(np.asarray(d), m)
+                with trace.span("secret.device_wait"):
+                    hits = np.asarray(d)
+                resolve(hits, m)
 
         def drain() -> None:
             while inflight:
                 d, m = inflight.popleft()
-                resolve(np.asarray(d), m)
+                with trace.span("secret.device_wait"):
+                    hits = np.asarray(d)
+                resolve(hits, m)
 
         try:
             for fidx, (path, data) in enumerate(files):
@@ -246,6 +251,10 @@ class TpuSecretScanner:
     # -- host confirmation --------------------------------------------------
 
     def _confirm(self, st: _FileState) -> Secret:
+        with trace.span("secret.confirm"):
+            return self._confirm_inner(st)
+
+    def _confirm_inner(self, st: _FileState) -> Secret:
         windows_by_id = {
             self.compiled.rule_ids[i]: w for i, w in st.rules.items()
         }

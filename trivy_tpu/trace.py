@@ -1,0 +1,80 @@
+"""Lightweight tracing surface (the ``--trace`` analog).
+
+The reference's only tracing is rego evaluation traces plumbed through an
+io.Writer (ref: pkg/iac/rego/options.go:34-35, pkg/misconf ScannerOption
+Trace). Here spans time the batched pipelines (device dispatch, host
+confirm, misconf evaluation, walk) and ``report()`` prints an aggregate
+table — the per-batch timing surface SURVEY §5 asks for.
+
+Disabled (zero overhead beyond one bool check) unless ``enable()`` runs,
+which the ``--trace`` flag does.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+_enabled = False
+_lock = threading.Lock()
+_spans: dict[str, list[float]] = defaultdict(list)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    with _lock:
+        _spans.clear()
+
+
+@contextmanager
+def span(name: str):
+    """Time a block under ``name``; no-op when tracing is off."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _lock:
+            _spans[name].append(dt)
+
+
+def add(name: str, seconds: float) -> None:
+    if _enabled:
+        with _lock:
+            _spans[name].append(seconds)
+
+
+def report(out=None) -> None:
+    """Aggregate span table (count / total / mean), widest totals first."""
+    if not _enabled:
+        return
+    out = out or sys.stderr
+    with _lock:
+        rows = [
+            (name, len(times), sum(times))
+            for name, times in _spans.items()
+        ]
+    if not rows:
+        return
+    rows.sort(key=lambda r: -r[2])
+    out.write("\n-- trace " + "-" * 51 + "\n")
+    out.write(f"{'span':<38}{'count':>7}{'total':>10}{'mean':>10}\n")
+    for name, count, total in rows:
+        out.write(
+            f"{name:<38}{count:>7}{total:>9.3f}s{total / count:>9.4f}s\n"
+        )
+    out.write("-" * 60 + "\n")
