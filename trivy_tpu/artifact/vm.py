@@ -1,0 +1,95 @@
+"""VM image artifact: raw disk image → analyzed like a rootfs
+(ref: pkg/fanal/artifact/vm/file.go — the local disk-image path; EBS/AMI
+sources need AWS egress and are out of scope here).
+
+Each scannable partition's files stream through the same analyzer group a
+filesystem scan uses; the blob is content-addressed by the image digest +
+analyzer versions, so re-scans of an unchanged image are cache hits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from trivy_tpu import log
+from trivy_tpu.artifact.local_fs import ArtifactOption
+from trivy_tpu.cache.key import calc_key
+from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
+from trivy_tpu.fanal.handler import HandlerManager
+from trivy_tpu.fanal.vm import walk_disk
+from trivy_tpu.fanal.walker import FileInfo
+from trivy_tpu.types import ArtifactReference
+
+logger = log.logger("artifact:vm")
+
+
+class VMImageArtifact:
+    type = "vm"
+
+    def __init__(self, path: str, cache, option: ArtifactOption | None = None):
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"disk image not found: {path}")
+        self.path = path
+        self.cache = cache
+        self.option = option or ArtifactOption()
+        self.group = AnalyzerGroup(
+            AnalyzerOptions(
+                disabled=self.option.disabled_analyzers,
+                secret_config_path=self.option.secret_config_path,
+                backend=self.option.backend,
+                extra=self.option.analyzer_extra,
+            )
+        )
+        self.handlers = HandlerManager()
+
+    def _image_digest(self) -> str:
+        """Digest of the image head + tail + size: rehashing a multi-GB
+        image per scan defeats the cache; head/tail/size changes on any
+        filesystem write that matters."""
+        h = hashlib.sha256()
+        st = os.stat(self.path)
+        h.update(str(st.st_size).encode())
+        with open(self.path, "rb") as f:
+            h.update(f.read(1 << 20))
+            if st.st_size > (1 << 20):
+                f.seek(max(1 << 20, st.st_size - (1 << 20)))
+                h.update(f.read(1 << 20))
+        return h.hexdigest()
+
+    def inspect(self) -> ArtifactReference:
+        # cache first: an unchanged image must not pay the walk again
+        blob_id = calc_key(
+            self._image_digest(),
+            analyzer_versions=self.group.versions(),
+            hook_versions=self.handlers.versions(),
+            skip_files=self.option.skip_files,
+            skip_dirs=self.option.skip_dirs,
+        )
+        _, missing = self.cache.missing_blobs(blob_id, [blob_id])
+        if not missing:
+            logger.debug("cache hit for %s -> %s", self.path, blob_id)
+            return ArtifactReference(
+                name=self.path, type=self.type, id=blob_id, blob_ids=[blob_id]
+            )
+        result = AnalysisResult()
+        post_files: dict = {}
+        n_files = 0
+        skips = set(self.option.skip_files)
+        skip_dirs = [d.strip("/") + "/" for d in self.option.skip_dirs]
+        for _part, fpath, size, opener in walk_disk(self.path):
+            if fpath in skips or any(fpath.startswith(d) for d in skip_dirs):
+                continue
+            n_files += 1
+            info = FileInfo(size=size, mode=0o644)
+            wanted = self.group.analyze_file(result, "", fpath, info, opener)
+            for t, content in wanted.items():
+                post_files.setdefault(t, {})[fpath] = content
+        self.group.finalize(result, post_files)
+        blob = result.to_blob_info()
+        self.handlers.post_handle(result, blob)
+        self.cache.put_blob(blob_id, blob.to_dict())
+        logger.debug("inspected %d files in %s -> %s", n_files, self.path, blob_id)
+        return ArtifactReference(
+            name=self.path, type=self.type, id=blob_id, blob_ids=[blob_id]
+        )

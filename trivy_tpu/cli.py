@@ -167,6 +167,8 @@ _TARGET_GROUPS = {
              license_flags, misconf_flags, db_flags, server_client_flags],
     "image": [global_flags, scan_flags, report_flags, secret_flags,
               license_flags, misconf_flags, db_flags, server_client_flags],
+    "vm": [global_flags, scan_flags, report_flags, secret_flags,
+           license_flags, misconf_flags, db_flags, server_client_flags],
     "sbom": [global_flags, scan_flags, report_flags, db_flags,
              server_client_flags],
     "convert": [global_flags, report_flags],
@@ -187,6 +189,7 @@ def build_parser() -> argparse.ArgumentParser:
         "rootfs": "scan an exported root filesystem",
         "repo": "scan a git repository (local path or remote URL)",
         "image": "scan a container image (archive or OCI layout)",
+        "vm": "scan a VM disk image (raw; MBR/GPT + ext4)",
         "sbom": "scan an SBOM (CycloneDX/SPDX) for vulnerabilities",
         "convert": "convert a saved JSON report into another format",
         "server": "run the scan server",

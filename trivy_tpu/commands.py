@@ -115,6 +115,8 @@ def run(command: str, ns, opts) -> int:
             return _run_fs_like(command, ns, opts)
         if command == "image":
             return _run_image(ns, opts)
+        if command == "vm":
+            return _run_vm(ns, opts)
         if command == "sbom":
             return _run_sbom(ns, opts)
         if command == "convert":
@@ -252,6 +254,17 @@ def _run_image(ns, opts) -> int:
         return 1
     cache = _make_cache(opts)
     artifact = ImageArchiveArtifact(target, cache, _artifact_option(ns, opts))
+    driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
+    return _emit(report, ns, opts)
+
+
+def _run_vm(ns, opts) -> int:
+    from trivy_tpu.artifact.vm import VMImageArtifact
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    cache = _make_cache(opts)
+    artifact = VMImageArtifact(ns.target, cache, _artifact_option(ns, opts))
     driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
     report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)

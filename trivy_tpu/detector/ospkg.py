@@ -73,6 +73,19 @@ EOL: dict[str, dict[str, date]] = {
                "12": date(2028, 6, 30)},
     "ubuntu": {"18.04": date(2023, 5, 31), "20.04": date(2025, 5, 31),
                "22.04": date(2027, 6, 1), "24.04": date(2029, 5, 31)},
+    # (ref: pkg/detector/ospkg/redhat/redhat.go eolDates and siblings)
+    "redhat": {"6": date(2020, 11, 30), "7": date(2024, 6, 30),
+               "8": date(2029, 5, 31), "9": date(2032, 5, 31)},
+    "centos": {"6": date(2020, 11, 30), "7": date(2024, 6, 30),
+               "8": date(2021, 12, 31)},
+    "alma": {"8": date(2029, 3, 1), "9": date(2032, 5, 31)},
+    "rocky": {"8": date(2029, 5, 31), "9": date(2032, 5, 31)},
+    "oracle": {"6": date(2021, 3, 1), "7": date(2024, 12, 1),
+               "8": date(2029, 7, 1), "9": date(2032, 6, 1)},
+    "amazon": {"1": date(2023, 12, 31), "2": date(2026, 6, 30),
+               "2022": date(2026, 11, 15), "2023": date(2028, 3, 15)},
+    "fedora": {"38": date(2024, 5, 21), "39": date(2024, 11, 26),
+               "40": date(2025, 5, 28), "41": date(2025, 11, 26)},
 }
 
 
@@ -120,7 +133,12 @@ def detect(db, os_info: OS, packages: list[Package]) -> list[DetectedVulnerabili
             for adv in db.get_advisories(bucket, name):
                 if adv.vulnerability_id in seen:
                     continue
-                if adv.arches and pkg.arch and pkg.arch not in adv.arches:
+                if (
+                    adv.arches
+                    and pkg.arch
+                    and pkg.arch != "noarch"  # noarch installs everywhere
+                    and pkg.arch not in adv.arches
+                ):
                     continue
                 if adv.fixed_version:
                     if compare(driver.scheme, installed, adv.fixed_version) >= 0:

@@ -76,7 +76,8 @@ def _select_bin(manifest: dict, plugin_dir: str) -> str:
             f"{sys_os}/{sys_arch}"
         )
     bin_path = os.path.normpath(os.path.join(plugin_dir, chosen.get("bin", "")))
-    if not bin_path.startswith(os.path.normpath(plugin_dir)):
+    root = os.path.normpath(plugin_dir)
+    if os.path.commonpath([bin_path, root]) != root:
         raise PluginError(f"plugin binary escapes plugin dir: {chosen.get('bin')}")
     if not os.path.exists(bin_path):
         raise PluginError(f"plugin binary not found: {bin_path}")
@@ -103,8 +104,16 @@ def install(source: str, root: str | None = None) -> dict:
                     tf.extractall(td, filter="data")
             except tarfile.TarError as e:
                 raise PluginError(f"cannot read plugin archive {source}: {e}") from e
-            entries = os.listdir(td)
-            src = td if "plugin.yaml" in entries else os.path.join(td, entries[0])
+            entries = sorted(os.listdir(td))
+            if "plugin.yaml" in entries:
+                src = td
+            elif len(entries) == 1:
+                src = os.path.join(td, entries[0])
+            else:
+                raise PluginError(
+                    f"{source}: archive must contain plugin.yaml at its root "
+                    "or exactly one plugin directory"
+                )
             manifest = _load_manifest(src)
             dest = os.path.join(base, manifest["name"])
             if os.path.exists(dest):

@@ -42,8 +42,16 @@ def post_scan(results: list) -> list:
     the reference iterates a map; sorted order is strictly better)."""
     for name in sorted(_post_scanners):
         try:
-            results = _post_scanners[name].post_scan(results)
+            out = _post_scanners[name].post_scan(results)
         except Exception as e:
             # hooks must not kill a scan (analyzer-error policy applies)
             logger.warning("post scanner %s failed: %s", name, e)
+            continue
+        if isinstance(out, list):
+            results = out
+        else:
+            logger.warning(
+                "post scanner %s returned %s, not a result list; ignored",
+                name, type(out).__name__,
+            )
     return results
