@@ -247,7 +247,9 @@ def test_parse_pom_and_jar():
     </dependency>
   </dependencies>
 </project>"""
-    pkgs = {p.name: p for p in P.parse_pom(pom)}
+    from trivy_tpu.dependency.pom import Resolver
+
+    pkgs = {p.name: p for p in Resolver(lambda _p: None).resolve(pom, "pom.xml")}
     assert pkgs["com.fasterxml.jackson.core:jackson-databind"].version == "2.15.2"
     assert pkgs["junit:junit"].dev
     jars = P.parse_jar_name("libs/jackson-databind-2.15.2.jar")

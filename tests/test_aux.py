@@ -193,3 +193,70 @@ class TestPlugin:
         plugin.install(str(plugin_src), root=root)
         with pytest.raises(plugin.PluginError):
             plugin.run("hello", [], root=root)
+
+
+K8S_DUMP = """\
+apiVersion: v1
+kind: List
+items:
+  - apiVersion: apps/v1
+    kind: Deployment
+    metadata: {name: web, namespace: prod}
+    spec:
+      template:
+        spec:
+          containers:
+            - name: app
+              image: nginx:latest
+              securityContext: {privileged: true}
+  - apiVersion: v1
+    kind: ConfigMap
+    metadata: {name: cfg, namespace: prod}
+    data: {k: v}
+"""
+
+
+class TestK8s:
+    def test_manifest_dump_scan(self, tmp_path):
+        from trivy_tpu import k8s
+
+        p = tmp_path / "dump.yaml"
+        p.write_text(K8S_DUMP)
+        docs = k8s.load_manifests(str(p))
+        assert len(docs) == 2  # List flattened
+        rows = k8s.scan_workloads(docs)
+        assert len(rows) == 1  # ConfigMap is not a workload
+        row = rows[0]
+        assert (row["namespace"], row["kind"], row["name"]) == ("prod", "Deployment", "web")
+        assert any(f.id == "KSV017" for f in row["failures"])  # privileged
+        assert row["severities"]["HIGH"] >= 1
+
+    def test_summary_writers(self, tmp_path):
+        from trivy_tpu import k8s
+
+        p = tmp_path / "dump.yaml"
+        p.write_text(K8S_DUMP)
+        rows = k8s.scan_workloads(k8s.load_manifests(str(p)))
+        table = io.StringIO()
+        k8s.write_summary(rows, table, "table")
+        assert "Workload Assessment" in table.getvalue()
+        jout = io.StringIO()
+        k8s.write_summary(rows, jout, "json")
+        import json as _json
+
+        doc = _json.loads(jout.getvalue())
+        assert doc["Resources"][0]["Kind"] == "Deployment"
+        assert doc["Resources"][0]["Misconfigurations"]
+
+    def test_manifest_dir_and_plain_docs(self, tmp_path):
+        from trivy_tpu import k8s
+
+        d = tmp_path / "manifests"
+        d.mkdir()
+        (d / "pod.yaml").write_text(
+            "apiVersion: v1\nkind: Pod\nmetadata: {name: p}\n"
+            "spec: {containers: [{name: c, image: x}]}\n"
+        )
+        (d / "notes.txt").write_text("ignored")
+        rows = k8s.scan_workloads(k8s.load_manifests(str(d)))
+        assert [r["name"] for r in rows] == ["p"]

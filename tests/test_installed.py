@@ -349,3 +349,113 @@ class TestEndToEndWithCVEs:
         assert "CVE-2022-27191" in vulns  # go binary dep
         assert "CVE-2023-32681" in vulns  # installed python pkg
         assert "CVE-2021-44228" in vulns  # jar via java DB
+
+
+class TestPomResolution:
+    """Maven parent-chain + dependencyManagement resolution
+    (ref: pkg/dependency/parser/java/pom/parse_test.go cases)."""
+
+    PARENT = """\
+<project xmlns="http://maven.apache.org/POM/4.0.0">
+  <groupId>com.acme</groupId>
+  <artifactId>parent</artifactId>
+  <version>1.2.3</version>
+  <packaging>pom</packaging>
+  <properties>
+    <spring.version>5.3.30</spring.version>
+    <shared.version>${project.version}</shared.version>
+  </properties>
+  <dependencyManagement>
+    <dependencies>
+      <dependency>
+        <groupId>org.springframework</groupId>
+        <artifactId>spring-core</artifactId>
+        <version>${spring.version}</version>
+      </dependency>
+      <dependency>
+        <groupId>junit</groupId>
+        <artifactId>junit</artifactId>
+        <version>4.13.2</version>
+        <scope>test</scope>
+      </dependency>
+    </dependencies>
+  </dependencyManagement>
+  <dependencies>
+    <dependency>
+      <groupId>org.slf4j</groupId>
+      <artifactId>slf4j-api</artifactId>
+      <version>2.0.9</version>
+    </dependency>
+  </dependencies>
+</project>
+"""
+
+    CHILD = """\
+<project xmlns="http://maven.apache.org/POM/4.0.0">
+  <parent>
+    <groupId>com.acme</groupId>
+    <artifactId>parent</artifactId>
+    <version>1.2.3</version>
+  </parent>
+  <artifactId>app</artifactId>
+  <dependencies>
+    <dependency>
+      <groupId>org.springframework</groupId>
+      <artifactId>spring-core</artifactId>
+    </dependency>
+    <dependency>
+      <groupId>junit</groupId>
+      <artifactId>junit</artifactId>
+    </dependency>
+    <dependency>
+      <groupId>com.acme</groupId>
+      <artifactId>shared</artifactId>
+      <version>${shared.version}</version>
+    </dependency>
+  </dependencies>
+</project>
+"""
+
+    def test_parent_chain(self, tmp_path):
+        from trivy_tpu.dependency.pom import Resolver, fs_loader
+
+        (tmp_path / "pom.xml").write_text(self.PARENT)
+        mod = tmp_path / "app"
+        mod.mkdir()
+        (mod / "pom.xml").write_text(self.CHILD)
+        pkgs = Resolver(fs_loader).resolve(
+            self.CHILD.encode(), str(mod / "pom.xml")
+        )
+        by_name = {p.name: p for p in pkgs}
+        # version from parent's dependencyManagement + property interpolation
+        assert by_name["org.springframework:spring-core"].version == "5.3.30"
+        # managed scope=test flows through
+        assert by_name["junit:junit"].dev is True
+        # parent's own dependency is inherited
+        assert by_name["org.slf4j:slf4j-api"].version == "2.0.9"
+        # property referencing project.version of the parent
+        assert by_name["com.acme:shared"].version == "1.2.3"
+
+    def test_analyzer_e2e(self, tmp_path):
+        from trivy_tpu.fanal.analyzers.lang import PomAnalyzer
+
+        (tmp_path / "pom.xml").write_text(self.PARENT)
+        mod = tmp_path / "app"
+        mod.mkdir()
+        (mod / "pom.xml").write_text(self.CHILD)
+        a = PomAnalyzer(AnalyzerOptions())
+        inp = AnalysisInput(
+            dir=str(tmp_path), file_path="app/pom.xml",
+            info=FileInfo(size=1, mode=0o644), content=self.CHILD.encode(),
+        )
+        res = a.analyze(inp)
+        names = {p.name for p in res.applications[0].packages}
+        assert "org.springframework:spring-core" in names
+
+    def test_single_pom_no_parent_on_disk(self):
+        from trivy_tpu.dependency.pom import Resolver
+
+        pkgs = Resolver(lambda _p: None).resolve(self.CHILD.encode(), "pom.xml")
+        # without the parent, neither the managed versions nor the
+        # ${shared.version} property resolve: nothing is guessed
+        assert pkgs == []

@@ -225,6 +225,13 @@ def build_parser() -> argparse.ArgumentParser:
         else:
             p.add_argument("target", help="scan target")
 
+    kp = sub.add_parser("k8s", help="scan Kubernetes workloads (manifests dump or kubectl)")
+    kp.add_argument("--manifests", default=None,
+                    help="manifest file/dir or cluster dump (kubectl get -o yaml/json)")
+    kp.add_argument("--context", default=None, help="kubectl context (live cluster)")
+    kp.add_argument("--format", default="table", choices=["table", "json"])
+    kp.add_argument("-o", "--output", default=None)
+
     pp = sub.add_parser("plugin", help="manage plugins (install/list/run/uninstall)")
     psub = pp.add_subparsers(dest="plugin_cmd")
     pi = psub.add_parser("install"); pi.add_argument("source")
@@ -253,6 +260,26 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps({"Version": VERSION}))
         else:
             print(f"trivy-tpu version {VERSION}")
+        return 0
+    if ns.command == "k8s":
+        import sys as _sys
+
+        from trivy_tpu import k8s
+
+        try:
+            if ns.manifests:
+                docs = k8s.load_manifests(ns.manifests)
+            else:
+                docs = k8s.load_cluster(ns.context)
+        except RuntimeError as e:
+            log.logger("cli").error("%s", e)
+            return 1
+        rows = k8s.scan_workloads(docs)
+        if ns.output:
+            with open(ns.output, "w") as f:
+                k8s.write_summary(rows, f, ns.format)
+        else:
+            k8s.write_summary(rows, _sys.stdout, ns.format)
         return 0
     if ns.command == "plugin":
         from trivy_tpu import plugin

@@ -268,48 +268,7 @@ def parse_nuget_lock(content: bytes, path: str = "") -> list[Package]:
     return [out[k] for k in sorted(out)]
 
 
-# --- Maven pom.xml (single-file resolution, ref: parser/java/pom) -----------
-
-
-def parse_pom(content: bytes, path: str = "") -> list[Package]:
-    import xml.etree.ElementTree as ET
-
-    try:
-        root = ET.fromstring(content)
-    except ET.ParseError:
-        return []
-    ns = ""
-    if root.tag.startswith("{"):
-        ns = root.tag.split("}")[0] + "}"
-
-    def text(el, tag, default=""):
-        node = el.find(f"{ns}{tag}")
-        return (node.text or "").strip() if node is not None and node.text else default
-
-    props = {}
-    props_el = root.find(f"{ns}properties")
-    if props_el is not None:
-        for child in props_el:
-            tag = child.tag.replace(ns, "")
-            props[tag] = (child.text or "").strip()
-    props.setdefault("project.version", text(root, "version"))
-    props.setdefault("project.groupId", text(root, "groupId"))
-
-    def interp(v: str) -> str:
-        m = re.fullmatch(r"\$\{([^}]+)\}", v or "")
-        return props.get(m.group(1), "") if m else (v or "")
-
-    pkgs = []
-    deps = root.find(f"{ns}dependencies")
-    if deps is not None:
-        for dep in deps.findall(f"{ns}dependency"):
-            g = interp(text(dep, "groupId"))
-            a = interp(text(dep, "artifactId"))
-            v = interp(text(dep, "version"))
-            scope = text(dep, "scope")
-            if g and a and v:
-                pkgs.append(_pkg(f"{g}:{a}", v, dev=scope == "test"))
-    return pkgs
+# --- Maven pom.xml: see trivy_tpu.dependency.pom (parent-chain resolver) ---
 
 
 # --- jar/war/ear filename heuristic (ref: parser/java/jar without javadb) ---

@@ -118,8 +118,11 @@ class JarAnalyzer(Analyzer):
 
 
 class PomAnalyzer(Analyzer):
+    """pom.xml with parent-chain/dependencyManagement resolution
+    (ref: pkg/dependency/parser/java/pom/parse.go)."""
+
     type = AnalyzerType.POM
-    version = 1
+    version = 2
 
     def __init__(self, options):
         pass
@@ -128,7 +131,23 @@ class PomAnalyzer(Analyzer):
         return os.path.basename(file_path) == "pom.xml"
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
-        pkgs = P.parse_pom(inp.content, inp.file_path)
+        from trivy_tpu.dependency.pom import Resolver, fs_loader
+
+        if inp.dir:
+            # parents resolve against the real scan tree
+            abs_path = os.path.join(inp.dir, inp.file_path)
+
+            def loader(path: str, _root=os.path.realpath(inp.dir)):
+                # clamp parent lookups inside the scan root; realpath on
+                # both sides so symlinked relativePaths cannot escape
+                real = os.path.realpath(path)
+                if os.path.commonpath([real, _root]) != _root:
+                    return None
+                return fs_loader(real)
+
+            pkgs = Resolver(loader).resolve(inp.content, abs_path)
+        else:  # image layers: no sibling files addressable — single pom
+            pkgs = Resolver(lambda _p: None).resolve(inp.content, inp.file_path)
         if not pkgs:
             return None
         return AnalysisResult(

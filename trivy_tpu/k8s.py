@@ -1,0 +1,177 @@
+"""Kubernetes vertical: scan cluster workloads and aggregate per-resource
+(ref: pkg/k8s — the reference enumerates a live cluster through the
+trivy-kubernetes library, scans each resource, and renders summary/all
+reports).
+
+Sources, in order of preference:
+
+- ``--manifests <dir-or-file>``: exported manifests / cluster dumps
+  (``kubectl get ... -o yaml|json``, incl. List objects) — works with
+  zero cluster access.
+- a live cluster via the ``kubectl`` binary when present (``kubectl get
+  <kinds> -A -o json``), the no-client-library analog of the reference's
+  cluster enumeration.
+
+Each workload document runs through the misconfiguration engine's
+kubernetes checks; results aggregate into per-resource rows with a
+severity summary, like the reference's summary writer (pkg/k8s/report).
+Image vulnerability scanning of cluster workloads requires registry pulls
+(egress) and is out of scope here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+
+from trivy_tpu import log
+from trivy_tpu.misconf.scanner import MisconfScanner, ScannerOption
+
+logger = log.logger("k8s")
+
+WORKLOAD_KINDS = (
+    "Pod", "Deployment", "StatefulSet", "DaemonSet", "ReplicaSet",
+    "Job", "CronJob",
+)
+_KUBECTL_KINDS = "pods,deployments,statefulsets,daemonsets,replicasets,jobs,cronjobs"
+
+SEVERITIES = ("CRITICAL", "HIGH", "MEDIUM", "LOW", "UNKNOWN")
+
+
+def _flatten(doc) -> list[dict]:
+    """Expand List/Table objects into their items."""
+    if not isinstance(doc, dict):
+        return []
+    if doc.get("kind", "").endswith("List") and isinstance(doc.get("items"), list):
+        out = []
+        for item in doc["items"]:
+            out.extend(_flatten(item))
+        return out
+    if doc.get("kind") and doc.get("apiVersion"):
+        return [doc]
+    return []
+
+
+def load_manifests(path: str) -> list[dict]:
+    """Workload documents from a manifest file or directory tree."""
+    import yaml
+
+    docs: list[dict] = []
+    errors: list[str] = []
+
+    def load_file(p: str) -> None:
+        try:
+            with open(p, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+            if p.endswith(".json"):
+                docs.extend(_flatten(json.loads(text)))
+            else:
+                for d in yaml.safe_load_all(text):
+                    docs.extend(_flatten(d))
+        except Exception as e:
+            errors.append(f"{p}: {e}")
+            logger.warning("cannot parse %s: %s", p, e)
+
+    if os.path.isdir(path):
+        for root, _dirs, names in os.walk(path):
+            for name in sorted(names):
+                if name.endswith((".yaml", ".yml", ".json")):
+                    load_file(os.path.join(root, name))
+    else:
+        load_file(path)
+    if not docs and errors:
+        # every input failed: a clean '0 workloads' report would lie
+        raise RuntimeError(
+            f"no parseable manifests in {path} ({len(errors)} errors; first: "
+            f"{errors[0][:200]})"
+        )
+    return docs
+
+
+def load_cluster(context: str | None = None) -> list[dict]:
+    """Enumerate workloads with kubectl (the zero-dependency cluster path)."""
+    cmd = ["kubectl", "get", _KUBECTL_KINDS, "-A", "-o", "json"]
+    if context:
+        cmd += ["--context", context]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except FileNotFoundError:
+        raise RuntimeError(
+            "kubectl not found — use --manifests with exported resources"
+        ) from None
+    except subprocess.TimeoutExpired as e:
+        raise RuntimeError("kubectl timed out after 120s") from e
+    if proc.returncode != 0:
+        raise RuntimeError(f"kubectl failed: {proc.stderr.strip()[:300]}")
+    try:
+        return _flatten(json.loads(proc.stdout))
+    except json.JSONDecodeError as e:
+        raise RuntimeError(f"kubectl produced invalid JSON: {e}") from e
+
+
+def scan_workloads(docs: list[dict], scanner: MisconfScanner | None = None):
+    """Per-resource misconfiguration rows:
+    [{namespace, kind, name, severities{...}, failures[...]}]."""
+    import yaml
+
+    scanner = scanner or MisconfScanner(ScannerOption(file_types=["kubernetes"]))
+    rows = []
+    for doc in docs:
+        kind = doc.get("kind", "")
+        if kind not in WORKLOAD_KINDS:
+            continue
+        meta = doc.get("metadata", {}) or {}
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "default")
+        text = yaml.safe_dump(doc, sort_keys=False)
+        mc = scanner.scan_file(f"{namespace}/{kind}/{name}.yaml", text.encode(),
+                               "kubernetes")
+        failures = list(mc.failures) if mc else []
+        sev = {s: 0 for s in SEVERITIES}
+        for f in failures:
+            sev[f.severity if f.severity in sev else "UNKNOWN"] += 1
+        rows.append({
+            "namespace": namespace,
+            "kind": kind,
+            "name": name,
+            "severities": sev,
+            "failures": failures,
+        })
+    rows.sort(key=lambda r: (r["namespace"], r["kind"], r["name"]))
+    return rows
+
+
+def write_summary(rows: list[dict], out, fmt: str = "table") -> None:
+    if fmt == "json":
+        json.dump(
+            {
+                "Resources": [
+                    {
+                        "Namespace": r["namespace"],
+                        "Kind": r["kind"],
+                        "Name": r["name"],
+                        "Summary": r["severities"],
+                        "Misconfigurations": [f.to_dict() for f in r["failures"]],
+                    }
+                    for r in rows
+                ],
+            },
+            out, indent=2,
+        )
+        out.write("\n")
+        return
+    out.write("\nWorkload Assessment\n")
+    header = f"{'Namespace':<16}{'Kind':<13}{'Name':<28}" + "".join(
+        f"{s[0]:>4}" for s in SEVERITIES
+    )
+    out.write(header + "\n")
+    out.write("-" * len(header) + "\n")
+    for r in rows:
+        out.write(
+            f"{r['namespace']:<16}{r['kind']:<13}{r['name'][:27]:<28}"
+            + "".join(f"{r['severities'][s]:>4}" for s in SEVERITIES)
+            + "\n"
+        )
+    total = sum(sum(r["severities"].values()) for r in rows)
+    out.write(f"\n{len(rows)} workloads, {total} misconfigurations\n")
