@@ -4,8 +4,10 @@ Covers the offline-resolvable core of the reference's ~2,500-LoC pom
 parser (ref: pkg/dependency/parser/java/pom/parse.go): parent-chain
 loading via relativePath, property interpolation (incl. project.* builtins
 and transitive properties), dependencyManagement version/scope inheritance,
-and dependency merging across the parent chain. Remote-repository resolution needs egress and is out of
-scope — unresolved versions stay empty rather than guessed.
+and dependency merging across the parent chain (every scope except test
+reports as a regular package; test marks dev). Remote-repository
+resolution needs egress and is out of scope — unresolved versions are
+dropped rather than guessed.
 """
 
 from __future__ import annotations
@@ -148,8 +150,9 @@ class Resolver:
                     v = managed.get("version", "")
                 if not scope:
                     scope = managed.get("scope", "")
-                if scope in ("provided", "system"):
-                    continue
+                # provided/system deps still ship in practice often enough
+                # that dropping their CVEs silently is the worse error —
+                # they are reported like compile deps
                 if not v:
                     logger.debug("%s: unresolved version for %s:%s", pom_path, g, a)
                     continue

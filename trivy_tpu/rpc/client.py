@@ -31,25 +31,39 @@ class RPCError(Exception):
 
 def _post(base: str, path: str, payload: dict, token: str, token_header: str,
           timeout: float, retries: int = MAX_RETRIES) -> dict:
+    import gzip as _gzip
+
     url = base.rstrip("/") + path
-    body = json.dumps(payload).encode()
+    raw = json.dumps(payload).encode()
+    # blobs compress extremely well (JSON metadata); gzip above 1 KiB
+    # (ref: the server mux wraps handlers in gzip middleware)
+    body = _gzip.compress(raw) if len(raw) > 1024 else raw
     backoff = 0.1
     last: Exception | None = None
     for attempt in range(retries + 1):
         req = urllib.request.Request(
             url, data=body, headers={"Content-Type": "application/json"}
         )
+        if body is not raw:
+            req.add_header("Content-Encoding", "gzip")
+        req.add_header("Accept-Encoding", "gzip")
         if token:
             req.add_header(token_header, token)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return json.loads(resp.read() or b"{}")
+                data = resp.read()
+                if resp.headers.get("Content-Encoding") == "gzip":
+                    data = _gzip.decompress(data)
+                return json.loads(data or b"{}")
         except urllib.error.HTTPError as e:
             if e.code in _RETRYABLE_HTTP and attempt < retries:
                 last = e
             else:
                 try:
-                    detail = json.loads(e.read() or b"{}").get("error", "")
+                    err_body = e.read() or b"{}"
+                    if e.headers.get("Content-Encoding") == "gzip":
+                        err_body = _gzip.decompress(err_body)
+                    detail = json.loads(err_body).get("error", "")
                 except Exception:
                     detail = ""
                 raise RPCError(f"{path}: HTTP {e.code} {detail}".strip()) from e

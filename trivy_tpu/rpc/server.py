@@ -145,9 +145,15 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             logger.debug("%s " + fmt, self.address_string(), *args)
 
         def _reply(self, code: int, payload: dict) -> None:
+            import gzip as _gzip
+
             body = json.dumps(payload).encode()
+            accepts_gzip = "gzip" in self.headers.get("Accept-Encoding", "")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            if accepts_gzip and len(body) > 1024:
+                body = _gzip.compress(body)
+                self.send_header("Content-Encoding", "gzip")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -185,7 +191,19 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 if length < 0 or length > MAX_REQUEST_BYTES:
                     self._reply(413, {"error": "request too large"})
                     return
-                req = json.loads(self.rfile.read(length) or b"{}")
+                raw = self.rfile.read(length)
+                if self.headers.get("Content-Encoding") == "gzip":
+                    import gzip as _gzip
+                    import io as _io
+
+                    # stream-decompress with a cap: checking size after a
+                    # full decompress would let a gzip bomb OOM the server
+                    with _gzip.GzipFile(fileobj=_io.BytesIO(raw)) as gz:
+                        raw = gz.read(MAX_REQUEST_BYTES + 1)
+                    if len(raw) > MAX_REQUEST_BYTES:
+                        self._reply(413, {"error": "request too large"})
+                        return
+                req = json.loads(raw or b"{}")
                 reloader = server.reloader
                 if reloader is not None:
                     reloader.request_begin()
