@@ -184,10 +184,15 @@ def bench_image_layers() -> dict:
     with tempfile.TemporaryDirectory() as td:
         archive = os.path.join(td, "img.tar")
         docker_save_tar(archive, layers)
+        from trivy_tpu.artifact.local_fs import ArtifactOption
+
+        # the metric is the cached layer-walk rate, a host-path number:
+        # CPU backend keeps 1,000 tiny per-layer batches off the device
+        opt = ArtifactOption(backend="cpu")
         cache = new_cache("fs", os.path.join(td, "cache"))
-        ImageArchiveArtifact(archive, cache).inspect()  # populate cache
+        ImageArchiveArtifact(archive, cache, opt).inspect()  # populate cache
         t0 = time.perf_counter()
-        ImageArchiveArtifact(archive, cache).inspect()  # cached walk
+        ImageArchiveArtifact(archive, cache, opt).inspect()  # cached walk
         dt = time.perf_counter() - t0
     return {
         "metric": "cached_image_layer_rate",

@@ -27,6 +27,30 @@ from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
 
 logger = log.logger("secret")
 
+# process-wide scanner cache: layer-parallel image analysis builds one
+# analyzer group per layer, and each group must NOT compile its own device
+# match program (concurrent per-layer compiles through a remote-compile
+# service can wedge; scan_files keeps all mutable state per-call, so one
+# scanner instance serves concurrent scans safely)
+_scanner_lock = __import__("threading").Lock()
+_scanner_cache: dict = {}
+
+
+def _shared_scanner(config, backend: str, parallel: int):
+    key = (id(config) if config is not None else None, backend, parallel)
+    with _scanner_lock:
+        if key not in _scanner_cache:
+            if backend == "cpu":
+                _scanner_cache[key] = SecretScanner(config)
+            else:
+                from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+                _scanner_cache[key] = TpuSecretScanner(
+                    config, confirm_workers=parallel
+                )
+        return _scanner_cache[key]
+
+
 # ref: secret.go:28-62
 SKIP_FILES = {
     "go.mod",
@@ -100,14 +124,9 @@ class SecretAnalyzer(BatchAnalyzer):
 
     def _exact(self) -> SecretScanner:
         if self._scanner is None:
-            if self._backend == "cpu":
-                self._scanner = SecretScanner(self._config)
-            else:
-                from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
-
-                self._scanner = TpuSecretScanner(
-                    self._config, confirm_workers=self._parallel
-                )
+            self._scanner = _shared_scanner(
+                self._config, self._backend, self._parallel
+            )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 
     @staticmethod
