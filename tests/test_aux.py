@@ -260,3 +260,41 @@ class TestK8s:
         (d / "notes.txt").write_text("ignored")
         rows = k8s.scan_workloads(k8s.load_manifests(str(d)))
         assert [r["name"] for r in rows] == ["p"]
+
+
+class TestSbomFileAnalyzer:
+    def test_bitnami_style_spdx(self):
+        import json as _json
+
+        from trivy_tpu.fanal.analyzer import AnalysisInput, AnalyzerOptions
+        from trivy_tpu.fanal.analyzers.sbom_file import SbomFileAnalyzer
+        from trivy_tpu.fanal.walker import FileInfo
+
+        bom = _json.dumps({
+            "bomFormat": "CycloneDX", "specVersion": "1.5",
+            "components": [{"type": "library", "name": "lodash",
+                            "version": "4.17.20",
+                            "purl": "pkg:npm/lodash@4.17.20"}],
+        }).encode()
+        a = SbomFileAnalyzer(AnalyzerOptions())
+        assert a.required("opt/bitnami/app/.spdx-app.spdx",
+                          FileInfo(size=10, mode=0o644))
+        assert not a.required("src/main.py", FileInfo(size=10, mode=0o644))
+        res = a.analyze(AnalysisInput(
+            dir="", file_path="opt/app/bom.json",
+            info=FileInfo(size=len(bom), mode=0o644), content=bom,
+        ))
+        pkg = res.applications[0].packages[0]
+        assert (pkg.name, pkg.version) == ("lodash", "4.17.20")
+
+    def test_garbage_sbom_ignored(self):
+        from trivy_tpu.fanal.analyzer import AnalysisInput, AnalyzerOptions
+        from trivy_tpu.fanal.analyzers.sbom_file import SbomFileAnalyzer
+        from trivy_tpu.fanal.walker import FileInfo
+
+        a = SbomFileAnalyzer(AnalyzerOptions())
+        res = a.analyze(AnalysisInput(
+            dir="", file_path="bom.json",
+            info=FileInfo(size=3, mode=0o644), content=b"not json",
+        ))
+        assert res is None

@@ -211,6 +211,7 @@ def build_parser() -> argparse.ArgumentParser:
         elif cmd == "clean":
             p.add_argument("--all", action="store_true", dest="clean_all")
             p.add_argument("--scan-cache", action="store_true")
+            p.add_argument("--vuln-db", action="store_true", dest="vuln_db")
         elif cmd == "repo":
             p.add_argument("--branch", default=None, help="branch to check out")
             p.add_argument("--tag", default=None, help="tag to check out")

@@ -313,12 +313,29 @@ def _run_server(ns, opts) -> int:
 def _run_clean(ns, opts) -> int:
     """Selective cleanup (ref: pkg/commands/clean/run.go — requires an
     explicit selector)."""
-    if not (getattr(ns, "clean_all", False) or getattr(ns, "scan_cache", False)):
-        logger.error("specify what to clean: --scan-cache or --all")
-        return 1
-    from trivy_tpu.cache import new_cache
+    import shutil
 
-    cache = new_cache("fs", opts.get("cache_dir"))
-    cache.clear()
-    logger.info("scan cache cleared")
+    clean_all = getattr(ns, "clean_all", False)
+    scan_cache = getattr(ns, "scan_cache", False) or clean_all
+    vuln_db = getattr(ns, "vuln_db", False) or clean_all
+    if not (scan_cache or vuln_db):
+        logger.error("specify what to clean: --scan-cache, --vuln-db or --all")
+        return 1
+    from trivy_tpu.cache.fs import default_cache_dir
+
+    base = opts.get("cache_dir") or default_cache_dir()
+    if scan_cache:
+        from trivy_tpu.cache import new_cache
+
+        new_cache("fs", opts.get("cache_dir")).clear()
+        logger.info("scan cache cleared")
+    if vuln_db:
+        import os.path
+
+        target = os.path.join(base, "db")
+        if os.path.isdir(target):
+            shutil.rmtree(target)
+            logger.info("%s removed", target)
+        else:
+            logger.info("%s not present", target)
     return 0

@@ -11,5 +11,6 @@ from trivy_tpu.fanal.analyzers import (  # noqa: F401
     pkg_apk,
     pkg_dpkg,
     pkg_rpm,
+    sbom_file,
     secret,
 )
