@@ -270,3 +270,47 @@ def test_base_layer_cache_key_differs(tmp_path):
     rep_b = Scanner(art, LocalDriver(cache)).scan_artifact(ScanOptions(scanners=["secret"]))
     assert any(s.rule_id == "aws-access-key-id"
                for r in rep_b.results for s in r.secrets)
+
+
+def test_apk_history_packages():
+    """apk add commands in image history yield pinned packages (unpinned
+    versions are unknowable; ref: imgconf/apk), minus later apk del —
+    including --virtual group deletion."""
+    from trivy_tpu.fanal.analyzers.imgconf import apk_history_packages
+
+    config = {"history": [
+        {"created_by": "/bin/sh -c apk --no-cache add curl=8.5.0-r0 "
+                       "ca-certificates && rm -rf /var/cache/apk/*"},
+        {"created_by": "/bin/sh -c apk add -t .build gcc=13.2.1-r0 && make "
+                       "&& apk del .build"},
+        {"created_by": "/bin/sh -c apk -X https://mirror.example/alpine "
+                       "add jq=1.7-r0"},
+        {"created_by": '/bin/sh -c #(nop)  CMD ["sh"]'},
+    ]}
+    pkgs = apk_history_packages(config)
+    by_name = {p.name: p.version for p in pkgs}
+    # unpinned ca-certificates dropped; virtual .build group deleted;
+    # pre-add flag with a space-separated argument handled
+    assert by_name == {"curl": "8.5.0-r0", "jq": "1.7-r0"}
+
+
+def test_apk_history_superseded_by_real_db():
+    """History reconstruction must not double-count when the real apk DB
+    was analyzed (applier drops the fallback PackageInfo)."""
+    from trivy_tpu.fanal.applier import apply_layers
+    from trivy_tpu.fanal.analyzers.imgconf import APK_HISTORY_TARGET
+    from trivy_tpu.types import BlobInfo, Package, PackageInfo
+
+    db_blob = BlobInfo(package_infos=[PackageInfo(
+        file_path="lib/apk/db/installed",
+        packages=[Package(name="curl", version="8.5.0-r0")],
+    )])
+    hist_blob = BlobInfo(package_infos=[PackageInfo(
+        file_path=APK_HISTORY_TARGET,
+        packages=[Package(name="curl", version="8.5.0-r0")],
+    )])
+    detail = apply_layers([db_blob, hist_blob])
+    assert len(detail.packages) == 1
+    # stripped-DB image: the fallback survives
+    detail = apply_layers([hist_blob])
+    assert len(detail.packages) == 1

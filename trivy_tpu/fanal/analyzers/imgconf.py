@@ -79,4 +79,96 @@ def analyze_image_config(config: dict, option) -> BlobInfo:
             if mc is not None and (mc.failures or mc.successes):
                 mc.file_path = HISTORY_TARGET
                 blob.misconfigurations.append(mc)
+
+    # apk packages named in history commands (ref: imgconf/apk — for images
+    # whose package DB was stripped, the `apk add` history still names what
+    # was installed). Only VERSION-PINNED packages are emitted: an empty
+    # installed version compares below every fixed version in the detector,
+    # which would flag every fixed CVE ever recorded — worse than silence.
+    if "apk-command" not in {
+        getattr(t, "value", t) for t in option.disabled_analyzers
+    }:
+        apk_pkgs = apk_history_packages(config)
+        if apk_pkgs:
+            from trivy_tpu.types import PackageInfo
+
+            blob.package_infos.append(
+                PackageInfo(file_path=APK_HISTORY_TARGET, packages=apk_pkgs)
+            )
     return blob
+
+
+APK_HISTORY_TARGET = "image history (apk commands)"
+
+# apk flags that consume the following token as their argument
+_APK_FLAGS_WITH_ARG = {
+    "-t", "--virtual", "-X", "--repository", "-p", "--root", "--cache-dir",
+    "--repositories-file", "--arch", "--wait",
+}
+
+def apk_history_packages(config: dict):
+    """Version-pinned packages installed by ``apk add`` across the build
+    history, minus anything later removed by ``apk del`` (incl. -t/--virtual
+    group deletions — the add-build-deps/del-build-deps pattern)."""
+    import re
+
+    from trivy_tpu.types import Package, PkgIdentifier
+
+    # leading "." marks a virtual group name (apk del .build-deps)
+    name_re = re.compile(r"\.?[a-z0-9][a-z0-9_.+-]*")
+    added: dict[str, str] = {}  # name -> version ("" when unpinned)
+    virtual: dict[str, list[str]] = {}  # virtual group -> member names
+    for h in config.get("history", []):
+        cmd = h.get("created_by") or ""
+        # each shell segment parses independently; flags may precede or
+        # follow the subcommand and may take space-separated arguments
+        for segment in re.split(r"&&|\|\||;|\|", cmd):
+            tokens = segment.split()
+            try:
+                apk_i = tokens.index("apk")
+            except ValueError:
+                continue
+            verb = None
+            group = None
+            names: list[tuple[str, str]] = []
+            i = apk_i + 1
+            while i < len(tokens):
+                tok = tokens[i]
+                if tok.startswith("-"):
+                    flag = tok.split("=", 1)[0]
+                    if "=" not in tok and flag in _APK_FLAGS_WITH_ARG:
+                        i += 1
+                        if flag in ("-t", "--virtual") and i < len(tokens):
+                            group = tokens[i]
+                    i += 1
+                    continue
+                if verb is None:
+                    if tok in ("add", "del"):
+                        verb = tok
+                    elif not name_re.fullmatch(tok):
+                        break  # not a parseable apk invocation
+                    i += 1
+                    continue
+                name, _, version = tok.partition("=")
+                if name_re.fullmatch(name):
+                    names.append((name, version))
+                i += 1
+            if verb == "add":
+                real = [(n, v) for n, v in names if not n.startswith(".")]
+                if group:
+                    virtual[group] = [n for n, _v in real]
+                for name, version in real:
+                    added[name] = version
+            elif verb == "del":
+                for name, _v in names:
+                    for member in virtual.pop(name, [name]):
+                        added.pop(member, None)
+    return [
+        Package(
+            name=name,
+            version=version,
+            identifier=PkgIdentifier(purl=f"pkg:apk/alpine/{name}@{version}"),
+        )
+        for name, version in sorted(added.items())
+        if version  # unpinned: unknowable version, see analyze_image_config
+    ]

@@ -71,6 +71,17 @@ def apply_layers(blobs: list[BlobInfo]) -> ArtifactDetail:
             misconf_by_path[mc.file_path] = mc
         detail.custom_resources.extend(blob.custom_resources)
 
+    # history-reconstructed apk packages are a fallback for stripped-DB
+    # images only: when a real package DB was analyzed, reconstruction
+    # would double-count every package (and its CVEs)
+    from trivy_tpu.fanal.analyzers.imgconf import APK_HISTORY_TARGET
+
+    if APK_HISTORY_TARGET in pkg_by_path and any(
+        path != APK_HISTORY_TARGET and pi.packages
+        for path, pi in pkg_by_path.items()
+    ):
+        del pkg_by_path[APK_HISTORY_TARGET]
+
     for pi in sorted(pkg_by_path.values(), key=lambda p: p.file_path):
         detail.packages.extend(pi.packages)
     detail.applications = [
