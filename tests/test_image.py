@@ -294,6 +294,20 @@ def test_apk_history_packages():
     assert by_name == {"curl": "8.5.0-r0", "jq": "1.7-r0"}
 
 
+def test_apk_history_virtual_equals_form():
+    """--virtual=.deps (inline-argument form) must capture the group name so
+    a later apk del .deps removes its members (advisor finding)."""
+    from trivy_tpu.fanal.analyzers.imgconf import apk_history_packages
+
+    config = {"history": [
+        {"created_by": "/bin/sh -c apk add --virtual=.deps gcc=13.2.1-r0 "
+                       "musl-dev=1.2.4-r2 && make && apk del .deps"},
+        {"created_by": "/bin/sh -c apk add curl=8.5.0-r0"},
+    ]}
+    pkgs = apk_history_packages(config)
+    assert {p.name: p.version for p in pkgs} == {"curl": "8.5.0-r0"}
+
+
 def test_apk_history_superseded_by_real_db():
     """History reconstruction must not double-count when the real apk DB
     was analyzed (applier drops the fallback PackageInfo)."""

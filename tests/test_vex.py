@@ -150,6 +150,58 @@ class TestCycloneDXVEX:
         assert report.results[0].modified_findings[0].status == "fixed"
 
 
+class TestUnresolvedProducts:
+    """Statements whose declared products resolved to zero purls must not
+    suppress everything (advisor finding: empty-purls = global match)."""
+
+    def test_openvex_productless_statement_is_global(self, tmp_path):
+        doc = dict(OPENVEX)
+        doc["statements"] = [
+            {"vulnerability": {"name": "CVE-2024-0001"}, "status": "not_affected"}
+        ]
+        p = tmp_path / "vex.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert not report.results[0].vulnerabilities
+
+    def test_cyclonedx_unresolved_affects_not_global(self, tmp_path):
+        doc = {
+            "bomFormat": "CycloneDX",
+            "components": [],
+            "vulnerabilities": [
+                {
+                    "id": "CVE-2024-0001",
+                    "analysis": {"state": "not_affected"},
+                    "affects": [{"ref": "ref-that-does-not-exist"}],
+                }
+            ],
+        }
+        p = tmp_path / "bom.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        # affects declared but unresolvable → must NOT suppress
+        assert len(report.results[0].vulnerabilities) == 1
+
+    def test_csaf_unresolved_product_ids_not_global(self, tmp_path):
+        doc = {
+            "document": {"category": "csaf_vex"},
+            "product_tree": {"branches": []},
+            "vulnerabilities": [
+                {
+                    "cve": "CVE-2024-0001",
+                    "product_status": {"known_not_affected": ["NO-SUCH-PRODUCT"]},
+                }
+            ],
+        }
+        p = tmp_path / "csaf.json"
+        p.write_text(json.dumps(doc))
+        report = _report(_vuln())
+        vex.filter_report(report, [str(p)])
+        assert len(report.results[0].vulnerabilities) == 1
+
+
 class TestCSAF:
     def test_known_not_affected(self, tmp_path):
         doc = {

@@ -68,6 +68,31 @@ class TestExt4:
             assert detect_filesystem(parts[0]) == "ext4"
 
 
+class TestUnwrittenExtents:
+    def test_unwritten_extent_reads_as_zeros(self):
+        """ext4 semantics: an extent with the high length bit set is
+        preallocated-but-unwritten and must read as zeros, not the stale
+        bytes at its physical location (advisor finding)."""
+        from trivy_tpu.fanal.vm import EXTENT_MAGIC
+
+        class StubReader:
+            def read_at(self, off, ln):
+                return b"\xde" * ln  # stale on-disk garbage
+
+        fs = object.__new__(Ext4)
+        fs.block_size = 1024
+        fs.r = StubReader()
+        # leaf node: 2 extents — written (lblk 0, len 1) then unwritten
+        # (lblk 1, len 1, high bit set)
+        node = struct.pack("<HHHH4x", EXTENT_MAGIC, 2, 4, 0)
+        node += struct.pack("<IHHI", 0, 1, 0, 100)
+        node += struct.pack("<IHHI", 1, 0x8001, 0, 101)
+        inode = {"size": 2048, "i_block": node, "mode": 0o100644, "flags": 0}
+        data = fs.read_file(inode)
+        assert data[:1024] == b"\xde" * 1024
+        assert data[1024:] == b"\x00" * 1024
+
+
 class TestMBR:
     def test_partitioned_disk(self, ext4_image, tmp_path):
         """Wrap the ext4 image in an MBR-partitioned disk at LBA 2048."""

@@ -44,12 +44,13 @@ class VMImageArtifact:
         self.handlers = HandlerManager()
 
     def _image_digest(self) -> str:
-        """Digest of the image head + tail + size: rehashing a multi-GB
-        image per scan defeats the cache; head/tail/size changes on any
-        filesystem write that matters."""
+        """Digest of the image head + tail + size + mtime: rehashing a
+        multi-GB image per scan defeats the cache; mtime catches in-place
+        rewrites whose changed blocks live outside the sampled head/tail."""
         h = hashlib.sha256()
         st = os.stat(self.path)
         h.update(str(st.st_size).encode())
+        h.update(str(st.st_mtime_ns).encode())
         with open(self.path, "rb") as f:
             h.update(f.read(1 << 20))
             if st.st_size > (1 << 20):

@@ -135,8 +135,12 @@ def apk_history_packages(config: dict):
             while i < len(tokens):
                 tok = tokens[i]
                 if tok.startswith("-"):
-                    flag = tok.split("=", 1)[0]
-                    if "=" not in tok and flag in _APK_FLAGS_WITH_ARG:
+                    flag, eq, inline_arg = tok.partition("=")
+                    if eq:
+                        # --virtual=.deps form: the argument rides the token
+                        if flag in ("-t", "--virtual"):
+                            group = inline_arg
+                    elif flag in _APK_FLAGS_WITH_ARG:
                         i += 1
                         if flag in ("-t", "--virtual") and i < len(tokens):
                             group = tokens[i]

@@ -218,9 +218,9 @@ class Ext4:
 
     # -- extent tree ---------------------------------------------------------
 
-    def _extents(self, node: bytes) -> list[tuple[int, int, int]]:
-        """(logical_block, length, physical_block) triples from an extent
-        node, recursing through index nodes."""
+    def _extents(self, node: bytes) -> list[tuple[int, int, int, bool]]:
+        """(logical_block, length, physical_block, unwritten) tuples from an
+        extent node, recursing through index nodes."""
         magic, entries, _max, depth = struct.unpack_from("<HHHH", node, 0)
         if magic != EXTENT_MAGIC:
             raise Ext4Error("non-extent-mapped inode (ext2-style mapping)")
@@ -229,8 +229,9 @@ class Ext4:
             for i in range(entries):
                 e = node[12 + i * 12 : 24 + i * 12]
                 lblk, ln, hi, lo = struct.unpack("<IHHI", e)
-                ln &= 0x7FFF  # high bit marks an unwritten extent
-                out.append((lblk, ln, (hi << 32) | lo))
+                unwritten = bool(ln & 0x8000)  # high bit: unwritten extent
+                ln &= 0x7FFF
+                out.append((lblk, ln, (hi << 32) | lo, unwritten))
             return out
         for i in range(entries):
             e = node[12 + i * 12 : 24 + i * 12]
@@ -243,11 +244,16 @@ class Ext4:
         size = inode["size"] if cap is None else min(inode["size"], cap)
         chunks = []
         got = 0
-        for lblk, ln, pblk in sorted(self._extents(inode["i_block"])):
-            want_end = lblk * self.block_size + ln * self.block_size
+        for lblk, ln, pblk, unwritten in sorted(self._extents(inode["i_block"])):
             if lblk * self.block_size >= size:
                 break
-            data = self.r.read_at(pblk * self.block_size, ln * self.block_size)
+            nbytes = ln * self.block_size
+            # ext4 semantics: unwritten (preallocated) extents read as zeros,
+            # not whatever stale bytes sit on disk at the physical location
+            if unwritten:
+                data = b"\x00" * nbytes
+            else:
+                data = self.r.read_at(pblk * self.block_size, nbytes)
             # sparse gap between extents fills with zeros
             gap = lblk * self.block_size - got
             if gap > 0:
@@ -255,7 +261,6 @@ class Ext4:
                 got += gap
             chunks.append(data)
             got += len(data)
-            del want_end
         out = b"".join(chunks)[:size]
         if len(out) < size:  # trailing sparse hole
             out += b"\x00" * (size - len(out))

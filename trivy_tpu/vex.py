@@ -58,6 +58,10 @@ class Statement:
     status: str  # not_affected | fixed | affected | under_investigation
     justification: str = ""
     source: str = ""
+    # True only when the document genuinely declared no products (OpenVEX
+    # product-less statements apply globally). Statements whose declared
+    # products failed to resolve to purls must NOT match everything.
+    match_all: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -127,7 +131,7 @@ class VexDocument:
             s
             for s in self.statements
             if s.vuln_id == vuln_id
-            and (not s.purls or any(purl_matches(p, purl) for p in s.purls))
+            and (s.match_all or any(purl_matches(p, purl) for p in s.purls))
         ]
         if not matched:
             return None
@@ -162,7 +166,8 @@ def _load_openvex(doc: dict) -> list[Statement]:
         vuln = stmt.get("vulnerability") or {}
         vuln_id = vuln.get("name", "") if isinstance(vuln, dict) else str(vuln)
         purls = []
-        for product in stmt.get("products", []) or []:
+        products = stmt.get("products", []) or []
+        for product in products:
             if isinstance(product, dict):
                 pid = product.get("@id", "")
                 if pid.startswith("pkg:"):
@@ -180,6 +185,7 @@ def _load_openvex(doc: dict) -> list[Statement]:
                 justification=stmt.get("justification", "")
                 or stmt.get("impact_statement", ""),
                 source="OpenVEX",
+                match_all=not products,
             )
         )
     return out
@@ -197,11 +203,17 @@ def _load_cyclonedx(doc: dict) -> list[Statement]:
         analysis = vuln.get("analysis") or {}
         status = _CDX_STATES.get(analysis.get("state", ""), "")
         purls = []
-        for affect in vuln.get("affects", []) or []:
+        affects = vuln.get("affects", []) or []
+        for affect in affects:
             ref = affect.get("ref", "")
             purl = ref_purl.get(ref, ref if ref.startswith("pkg:") else "")
             if purl:
                 purls.append(purl)
+        if not purls:
+            # affects were declared but none resolved to a purl (or none were
+            # declared at all) — suppressing everything would silently hide
+            # real vulnerabilities; CDX VEX matching is product-based only.
+            continue
         out.append(
             Statement(
                 vuln_id=vuln.get("id", ""),
@@ -244,7 +256,9 @@ def _load_csaf(doc: dict) -> list[Statement]:
         ):
             ids = status_map.get(key) or []
             stmt_purls = [purls[i] for i in ids if i in purls]
-            if not ids:
+            if not stmt_purls:
+                # no product ids, or ids that resolved to no purls — do
+                # not let this statement match every package
                 continue
             out.append(
                 Statement(
