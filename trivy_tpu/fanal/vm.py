@@ -229,8 +229,13 @@ class Ext4:
             for i in range(entries):
                 e = node[12 + i * 12 : 24 + i * 12]
                 lblk, ln, hi, lo = struct.unpack("<IHHI", e)
-                unwritten = bool(ln & 0x8000)  # high bit: unwritten extent
-                ln &= 0x7FFF
+                # ee_len semantics (kernel ext4_ext_is_unwritten): an extent
+                # is unwritten iff ee_len > 32768; ee_len == 32768 is a
+                # maximal *initialized* extent (EXT_INIT_MAX_LEN), so a plain
+                # high-bit mask would misread 128 MiB written runs as empty
+                unwritten = ln > 32768
+                if unwritten:
+                    ln -= 32768
                 out.append((lblk, ln, (hi << 32) | lo, unwritten))
             return out
         for i in range(entries):

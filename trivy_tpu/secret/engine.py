@@ -188,6 +188,17 @@ class SecretScanner:
             if start == end or start < 0:
                 continue
             locs.append(Location(start, end))
+        return self._filter_locations(rule, content, locs, global_blocks)
+
+    def _filter_locations(
+        self,
+        rule: Rule,
+        content: str,
+        locs: list[Location],
+        global_blocks: list[tuple[int, int]] | None,
+    ) -> list[Location]:
+        """Exclude-block and allow-regex suppression shared by every
+        location-finding strategy."""
         if not locs:
             return []
         # exclude-block suppression: a location is dropped only when a block
@@ -215,6 +226,50 @@ class SecretScanner:
             ]
         return locs
 
+    def find_rule_locations_fullscan(
+        self,
+        rule: Rule,
+        content: str,
+        lower: str,
+        global_blocks: list[tuple[int, int]] | None = None,
+    ) -> list[Location]:
+        """:meth:`find_rule_locations` semantics, but unbounded-width rules
+        locate candidate match starts with the bounded start-detector and
+        take the true extent via ``match()`` — avoiding the regex engine's
+        whole-content rescan. Used by the TPU confirm path for device
+        keyword-lane rules, where flagged chunks bound the *keyword*
+        position, not the match start, so no window restriction is sound.
+        """
+        det = None
+        if not rule.has_lookaround:
+            wmax = rule.max_match_width
+            if wmax is None or wmax > 8192:
+                det = rule.start_detector
+        if det is None:
+            return self.find_rule_locations(rule, content, lower, global_blocks)
+        if not rule.match_keywords(lower):
+            return []
+        locs: list[Location] = []
+        n = len(content)
+        pos = 0
+        while pos < n:
+            dm = det[0].search(content, pos)
+            if dm is None:
+                break
+            m = rule.regex_re.match(content, dm.start())
+            if m is None:
+                pos = dm.start() + 1
+                continue
+            if rule.secret_group_name and rule.secret_group_name in rule.regex_re.groupindex:
+                start, end = m.span(rule.secret_group_name)
+            else:
+                start, end = m.span()
+            pos = m.end() if m.end() > dm.start() else dm.start() + 1
+            if start == end or start < 0:
+                continue
+            locs.append(Location(start, end))
+        return self._filter_locations(rule, content, locs, global_blocks)
+
     def find_rule_locations_in_windows(
         self,
         rule: Rule,
@@ -224,26 +279,42 @@ class SecretScanner:
         global_blocks: list[tuple[int, int]] | None = None,
     ) -> list[Location]:
         """Same results as :meth:`find_rule_locations` restricted to matches
-        anchored inside the given windows (the device-flagged chunk spans).
+        whose *start* lies inside the given windows.
 
-        Uses ``finditer(pos, endpos)`` rather than slicing so ``^``,
-        lookbehind and word-prefix alternations see the *real* surrounding
-        context; windows are padded by the rule's max match width (falling
-        back to a full scan for unbounded-width rules), which both admits
-        matches straddling a window edge and preserves the engine's
-        non-overlapping-match consumption order.
+        SOUND ONLY when the device guarantees flagged chunks contain the
+        match start: the anchored device lane (anchor literal at fixed
+        offset from the match start), or the keyword lane for bounded-width
+        rules whose keyword provably sits inside every match
+        (``Rule.keyword_in_match`` — the keyword occurrence then bounds the
+        start within ``max_match_width``). Keyword-lane rules without that
+        proof must use :meth:`find_rule_locations_fullscan` instead — the
+        caller (TpuSecretScanner._confirm_inner) enforces this split.
+
+        Bounded-width rules use ``search(pos, endpos)`` over windows padded
+        by the match width so ``^``/lookbehind/word-prefix see real context;
+        unbounded-width rules locate candidate starts with the bounded
+        start-detector prefix and take the true extent via ``match()``.
+        Lookaround rules fall back to a full scan (their context is
+        unbounded by getwidth()).
         """
         if not rule.match_keywords(lower):  # keywords are a whole-file test
             return []
         wmax = rule.max_match_width
-        if wmax is None or wmax > 8192 or rule.has_lookaround:
+        if rule.has_lookaround:
             # lookarounds examine context beyond getwidth()'s bound, so the
             # fixed padding below cannot guarantee parity — full scan instead
             return self.find_rule_locations(rule, content, lower, global_blocks)
+        detector = None
+        if wmax is None or wmax > 8192:
+            # unbounded match width: locate candidate starts with the bounded
+            # start-detector prefix, then take the true (unbounded) extent
+            # via match() at each candidate — no full-file rescans
+            detector = rule.start_detector
+            if detector is None:
+                return self.find_rule_locations(rule, content, lower, global_blocks)
         n = len(content)
-        # slack beyond the match width for anchor/word-prefix context; rules
-        # with lookarounds never reach this path (full-scan fallback above)
-        pad = wmax + 256
+        # slack beyond the match width for anchor/word-prefix context
+        pad = (detector[1] if detector else wmax) + 256
         ivs = sorted((max(0, s - pad), min(n, e + pad)) for s, e in windows)
         merged: list[list[int]] = []
         for s, e in ivs:
@@ -253,22 +324,34 @@ class SecretScanner:
                 merged.append([s, e])
         verify_edges = rule.has_end_anchor
         locs: list[Location] = []
+        pos = 0  # carried across windows: preserves finditer's global
+        # non-overlapping consumption order when a match spans a gap
         for s, e in merged:
-            pos = s
+            pos = max(pos, s)
             while pos <= e:
-                m = rule.regex_re.search(content, pos, e)
-                if m is None:
-                    break
-                if verify_edges and e < n and m.end() >= e - 1:
-                    # finditer's endpos acts as end-of-string, so a terminal
-                    # $/\Z (incl. the before-trailing-\n form) may have fired
-                    # mid-content; re-match at the same start against the real
-                    # string end — the authoritative span the full scan sees
-                    m2 = rule.regex_re.match(content, m.start())
-                    if m2 is None:
-                        pos = m.start() + 1
+                if detector is not None:
+                    dm = detector[0].search(content, pos, min(n, e + detector[1]))
+                    if dm is None or dm.start() > e:
+                        break
+                    m = rule.regex_re.match(content, dm.start())
+                    if m is None:
+                        pos = dm.start() + 1
                         continue
-                    m = m2
+                else:
+                    m = rule.regex_re.search(content, pos, e)
+                    if m is None:
+                        break
+                    if verify_edges and e < n and m.end() >= e - 1:
+                        # finditer's endpos acts as end-of-string, so a
+                        # terminal $/\Z (incl. the before-trailing-\n form)
+                        # may have fired mid-content; re-match at the same
+                        # start against the real string end — the
+                        # authoritative span the full scan sees
+                        m2 = rule.regex_re.match(content, m.start())
+                        if m2 is None:
+                            pos = m.start() + 1
+                            continue
+                        m = m2
                 if (
                     rule.secret_group_name
                     and rule.secret_group_name in rule.regex_re.groupindex
@@ -281,30 +364,9 @@ class SecretScanner:
                 if start == end or start < 0:
                     continue
                 locs.append(Location(start, end))
-        if not locs:
-            return []
         # exclude blocks and allow regexes replicate find_rule_locations over
         # the full content (a block straddling a window must still suppress)
-        blocks: list[tuple[int, int]] = list(
-            global_blocks if global_blocks is not None else self.global_block_spans(content)
-        )
-        for pat in rule.exclude_block_res:
-            blocks.extend(m.span() for m in pat.finditer(content))
-        if blocks:
-            locs = [
-                l
-                for l in locs
-                if not any(bs <= l.start and l.end <= be for bs, be in blocks)
-            ]
-        allow_res = [a.regex_re for a in rule.allow_rules if a.regex_re is not None]
-        allow_res += [a.regex_re for a in self.allow_rules if a.regex_re is not None]
-        if allow_res:
-            locs = [
-                l
-                for l in locs
-                if not any(p.search(content[l.start : l.end]) for p in allow_res)
-            ]
-        return locs
+        return self._filter_locations(rule, content, locs, global_blocks)
 
     # -- full scan ----------------------------------------------------------
 

@@ -116,6 +116,166 @@ class Rule:
             return None
 
     @cached_property
+    def start_detector(self) -> tuple[re.Pattern, int] | None:
+        """Bounded *match-start detector* for unbounded-width rules.
+
+        A compiled prefix of the pattern — truncated at the first
+        unbounded repeat — such that any full-pattern match at position
+        ``p`` implies the detector matches at ``p``, with finite max
+        width.  The windowed confirm path (engine.find_rule_locations_in
+        _windows) uses it to locate candidate starts inside device-flagged
+        chunks and then runs the true regex via ``match(content, start)``
+        for the exact unbounded extent, instead of rescanning the whole
+        file (ref: the full-scan hot loop pkg/fanal/secret/scanner.go:377
+        that this replaces).
+
+        Returns ``(pattern, max_width)`` or None when no useful bounded
+        prefix exists (unbounded from the first element) — callers then
+        fall back to a full-content scan.
+        """
+        try:
+            import re._compiler as sre_compile
+            import re._parser as sre_parse
+
+            MAXW = sre_parse.MAXWIDTH
+
+            def item_width(state, op, av) -> int:
+                probe = sre_parse.SubPattern(state, [(op, av)])
+                return probe.getwidth()[1]
+
+            def truncate(sub):
+                """Longest bounded prefix of ``sub``'s concatenation;
+                second value False when truncation happened (stop after)."""
+                out = sre_parse.SubPattern(sub.state)
+                for op, av in sub.data:
+                    if item_width(sub.state, op, av) < MAXW:
+                        out.data.append((op, av))
+                        continue
+                    name = str(op)
+                    if name == "SUBPATTERN":
+                        group, add_f, del_f, inner = av
+                        tin, _ = truncate(inner)
+                        if tin.data:
+                            out.data.append((op, (group, add_f, del_f, tin)))
+                    elif name in ("MAX_REPEAT", "MIN_REPEAT"):
+                        lo, _hi, item = av
+                        if lo > 0 and item.getwidth()[1] < MAXW:
+                            out.data.append((op, (lo, lo, item)))
+                    # anything else unbounded (branch, conditional): stop
+                    # before it — the kept prefix is still a sound anchor
+                    return out, False
+                return out, True
+
+            parsed = sre_parse.parse(self.regex)
+            out, _ = truncate(parsed)
+            if not out.data:
+                return None
+            _, width = out.getwidth()
+            if width == 0 or width >= MAXW:
+                return None
+            return sre_compile.compile(out), int(width)
+        except Exception:
+            return None
+
+    @cached_property
+    def keyword_in_match(self) -> bool:
+        """True when every match provably contains one of the rule's
+        keywords (case-insensitively).
+
+        Decides whether chunk-windowed confirmation is sound for the
+        keyword device lane: the device flags chunks where a *keyword*
+        occurs, so windows only cover match starts when the keyword is
+        guaranteed to sit inside the match (within ``max_match_width`` of
+        its start).  Proved by folding the pattern into mandatory
+        lowercased character runs — a keyword inside a mandatory run is
+        present in every match; anything unprovable returns False and the
+        confirm falls back to a full-content scan (the reference's
+        file-level keyword semantics, pkg/fanal/secret/scanner.go:174-186).
+        """
+        if not self.lower_keywords:
+            return False
+        try:
+            import re._constants as sre_c
+            import re._parser as sre_parse
+
+            def fold_char(chars: frozenset) -> str | None:
+                """Single lowercase char every member folds to, or None."""
+                folded = {chr(c).lower() for c in chars if c < 256}
+                return folded.pop() if len(folded) == 1 else None
+
+            def single(op, av) -> frozenset | None:
+                if op is sre_c.LITERAL:
+                    return frozenset({av}) if av < 256 else None
+                if op is sre_c.IN:
+                    chars: set[int] = set()
+                    for iop, iav in av:
+                        if iop is sre_c.LITERAL and iav < 256:
+                            chars.add(iav)
+                        elif iop is sre_c.RANGE:
+                            lo, hi = iav
+                            chars.update(range(lo, min(hi, 255) + 1))
+                        else:
+                            return None
+                    return frozenset(chars)
+                return None
+
+            MAX_PATHS = 64
+
+            def walk(nodes, paths: list[list[str]]) -> None:
+                """Accumulate mandatory folded fragments per alternation
+                path; un-foldable constructs end the current fragment."""
+
+                def append(text: str | None) -> None:
+                    for p in paths:
+                        if text is None:
+                            if p[-1]:
+                                p.append("")
+                        else:
+                            p[-1] += text
+
+                for op, av in nodes:
+                    name = str(op)
+                    if name in ("LITERAL", "IN"):
+                        cs = single(op, av)
+                        append(fold_char(cs) if cs else None)
+                    elif name in ("MAX_REPEAT", "MIN_REPEAT"):
+                        lo, hi, sub = av
+                        sub = list(sub)
+                        ch = None
+                        if lo > 0 and lo <= 256 and len(sub) == 1:
+                            cs = single(*sub[0])
+                            ch = fold_char(cs) if cs else None
+                        append(ch * lo if ch else None)
+                        if hi != lo:
+                            append(None)
+                    elif name == "SUBPATTERN":
+                        _g, _af, _df, sub = av
+                        walk(list(sub), paths)
+                    elif name == "BRANCH":
+                        _, alts = av
+                        if len(paths) * len(alts) > MAX_PATHS:
+                            append(None)
+                            continue
+                        forked: list[list[str]] = []
+                        for alt in alts:
+                            alt_paths = [list(p) for p in paths]
+                            walk(list(alt), alt_paths)
+                            forked.extend(alt_paths)
+                        paths[:] = forked
+                    else:
+                        # AT/ASSERT/GROUPREF/...: conservatively break
+                        append(None)
+
+            paths: list[list[str]] = [[""]]
+            walk(list(sre_parse.parse(self.regex)), paths)
+            kws = self.lower_keywords
+            return all(
+                any(k in frag for frag in p for k in kws) for p in paths
+            )
+        except Exception:
+            return False
+
+    @cached_property
     def has_lookaround(self) -> bool:
         """True when the pattern contains lookahead/lookbehind assertions.
         Lookarounds contribute zero to getwidth(), so window-restricted

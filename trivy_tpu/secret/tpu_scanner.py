@@ -8,9 +8,12 @@ pkg/fanal/secret/scanner.go:377 and SURVEY.md §3.2):
   candidate rules → findings (byte-identical to the CPU backend).
 
 Chunk overlap equals the compiled ruleset's maximum device window, so every
-device-checkable window lies fully inside at least one chunk — matches
-longer than the window (e.g. private-key bodies) only need their *anchor
-window* contained; the host confirm then runs over the whole file.
+device-checkable window lies fully inside at least one chunk. The host
+confirm is window-restricted only where the flagged chunk provably bounds
+the match start (anchored lane; keyword lane with the keyword inside every
+match — see ``_windowed_ids``); other keyword-lane rules rescan the whole
+file on flag, with unbounded-width regexes accelerated by their bounded
+start-detector prefix (``Rule.start_detector``).
 
 Batches are dispatched asynchronously (JAX dispatch is async by default)
 through a depth-PIPELINE_DEPTH pipeline: the host packs batches N+1..N+k
@@ -24,6 +27,7 @@ release the GIL).
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from collections.abc import Iterable, Iterator
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -118,6 +122,21 @@ class TpuSecretScanner:
                 f">= {2 * self.overlap}"
             )
         self._rules_by_id = {r.id: r for r in self.exact.rules}
+        # windowed confirmation is sound only when flagged chunks bound the
+        # match START: always true on the anchored lane; true on the keyword
+        # lane only for bounded-width rules whose keyword provably sits
+        # inside every match (the keyword occurrence then pins the start
+        # within max_match_width). Everything else full-scans on flag.
+        anchored = set(self.compiled.anchored_rule_ids)
+        self._windowed_ids = anchored | {
+            r.id
+            for r in self.exact.rules
+            if r.id not in anchored
+            and r.keywords
+            and r.keyword_in_match
+            and r.max_match_width is not None
+            and r.max_match_width <= 8192
+        }
         self.confirm_workers = confirm_workers or CONFIRM_WORKERS
 
         from trivy_tpu.parallel.mesh import pad_batch, sharded_match_fn
@@ -142,6 +161,18 @@ class TpuSecretScanner:
 
     # -- core batching loop -------------------------------------------------
 
+    def _run_batch(self, batch: np.ndarray) -> np.ndarray:
+        """device_put → match → fetch for one dispatch-shaped batch.
+
+        Runs on a worker thread: the host→device transfer and the blocking
+        device wait both release the GIL, so packing/confirm work on other
+        threads overlaps with the wire and the kernel.
+        """
+        with trace.span("secret.dispatch"):
+            dev = self._match(batch)
+        with trace.span("secret.device_wait"):
+            return np.asarray(dev)
+
     def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
         """Scan many files; yields per-file results in input order."""
         # order-preserving result store; files resolve once all chunks
@@ -152,7 +183,7 @@ class TpuSecretScanner:
         total = 0
 
         # ring of host batch buffers: a buffer is only refilled once its
-        # dispatch has resolved (inflight is bounded by PIPELINE_DEPTH), so
+        # batch task has resolved (inflight is bounded by PIPELINE_DEPTH), so
         # no copy or re-zeroing per batch is needed — crucial because on the
         # CPU backend jax may alias the numpy buffer zero-copy, and mutating
         # a dispatched batch would corrupt it mid-flight
@@ -163,8 +194,21 @@ class TpuSecretScanner:
         buf_i = 0
         buf = bufs[0]
         meta: list[int] = []  # file index per buffered chunk
-        inflight: deque = deque()  # (device_result, meta_snapshot)
+        inflight: deque = deque()  # (batch Future, meta_snapshot)
         pool = ThreadPoolExecutor(max_workers=self.confirm_workers)
+        # batch tasks overlap transfer N+1 with kernel N through the device
+        # queue; two threads suffice (more just contend on the link)
+        batch_pool = ThreadPoolExecutor(max_workers=2)
+        # backpressure: bounds queued+running confirms so a slow confirm
+        # pool cannot accumulate unbounded _FileState.data on a large
+        # streaming scan (file bytes are released once its confirm runs)
+        confirm_slots = threading.Semaphore(self.confirm_workers * 4)
+
+        def confirm_task(st: _FileState) -> Secret:
+            try:
+                return self._confirm(st)
+            finally:
+                confirm_slots.release()
 
         def resolve(batch_hits: np.ndarray, batch_meta: list) -> None:
             # one vectorized nonzero per batch, not one per row
@@ -178,7 +222,8 @@ class TpuSecretScanner:
                 st = states[fidx]
                 st.pending -= 1
                 if st.pending == 0:
-                    results[fidx] = pool.submit(self._confirm, st)
+                    confirm_slots.acquire()
+                    results[fidx] = pool.submit(confirm_task, st)
                     del states[fidx]
 
         def flush():
@@ -186,9 +231,7 @@ class TpuSecretScanner:
             if not meta:
                 return
             n = next(b for b in self._buckets if b >= len(meta))
-            with trace.span("secret.dispatch"):
-                dev = self._match(buf[:n])  # async dispatch, fixed bucket shape
-            inflight.append((dev, meta))
+            inflight.append((batch_pool.submit(self._run_batch, buf[:n]), meta))
             meta = []
             # rotate to the next ring buffer; full rows are overwritten on
             # fill and partial rows zero their own tails (stale rows past
@@ -197,17 +240,13 @@ class TpuSecretScanner:
             buf_i = (buf_i + 1) % len(bufs)
             buf = bufs[buf_i]
             while len(inflight) >= PIPELINE_DEPTH:
-                d, m = inflight.popleft()
-                with trace.span("secret.device_wait"):
-                    hits = np.asarray(d)
-                resolve(hits, m)
+                fut, m = inflight.popleft()
+                resolve(fut.result(), m)
 
         def drain() -> None:
             while inflight:
-                d, m = inflight.popleft()
-                with trace.span("secret.device_wait"):
-                    hits = np.asarray(d)
-                resolve(hits, m)
+                fut, m = inflight.popleft()
+                resolve(fut.result(), m)
 
         try:
             for fidx, (path, data) in enumerate(files):
@@ -243,6 +282,7 @@ class TpuSecretScanner:
                 next_emit += 1
         finally:
             pool.shutdown(wait=False)
+            batch_pool.shutdown(wait=False)
 
     def scan_bytes(self, path: str, data: bytes) -> Secret:
         """Single-file convenience (still device-prefiltered)."""
@@ -267,10 +307,18 @@ class TpuSecretScanner:
         hits = []
         for rule in self.exact.rules_for_path(st.path):
             if rule.id in windows_by_id:
-                # regex runs only around the device-flagged chunk windows
-                locs = self.exact.find_rule_locations_in_windows(
-                    rule, content, lower, windows_by_id[rule.id], global_blocks
-                )
+                if rule.id in self._windowed_ids:
+                    # regex runs only around the device-flagged chunk windows
+                    locs = self.exact.find_rule_locations_in_windows(
+                        rule, content, lower, windows_by_id[rule.id], global_blocks
+                    )
+                else:
+                    # keyword lane without a start bound: the flagged chunk
+                    # locates the keyword, not the match — full-content scan
+                    # (detector-accelerated for unbounded-width rules)
+                    locs = self.exact.find_rule_locations_fullscan(
+                        rule, content, lower, global_blocks
+                    )
             elif rule.id in host_ids:
                 locs = self.exact.find_rule_locations(
                     rule, content, lower, global_blocks
