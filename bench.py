@@ -159,28 +159,53 @@ def bench_license(rng) -> dict:
 
 
 def bench_cve(rng) -> dict:
-    """BASELINE config 4 analog: 50k-package CVE match against an advisory
-    set, exercising the batched device constraint path."""
+    """BASELINE config 4 analog: 50k-package CVE match against a
+    realistically-shaped advisory DB — >=100k advisories spread over the
+    real trivy-db bucket-name schema (multiple '<eco>::<source>' buckets
+    per ecosystem, messy pre-release versions), exercising the merged
+    prefix index and the batched device constraint path."""
     from trivy_tpu.db import Advisory, VulnDB
     from trivy_tpu.detector import library
     from trivy_tpu.types import Application, Package
 
     n_pkgs = 50_000
-    n_advisories = 5_000
-    bucket: dict[str, list[Advisory]] = {}
-    for i in range(n_advisories):
-        bucket[f"pkg-{i:05d}"] = [
-            Advisory(
-                vulnerability_id=f"CVE-2024-{i:05d}",
-                vulnerable_versions=[f"<{(i % 9) + 1}.{i % 10}.0"],
-                patched_versions=[f"{(i % 9) + 1}.{i % 10}.0"],
+    # real source-bucket names per the trivy-db schema
+    bucket_plan = [
+        ("npm::GitHub Security Advisory Npm", 30_000),
+        ("npm::Node.js Ecosystem Security Working Group", 10_000),
+        ("pip::GitHub Security Advisory Pip", 20_000),
+        ("pip::OSV/PyPA Advisory Database", 8_000),
+        ("go::GitHub Security Advisory Go", 15_000),
+        ("go::GitLab Advisory Database Community", 7_000),
+        ("composer::GitHub Security Advisory Composer", 6_000),
+        ("composer::php-security-advisories", 2_000),
+        ("rubygems::ruby-advisory-db", 4_000),
+        ("cargo::GitHub Security Advisory Rust", 4_000),
+    ]
+    suffixes = ["", "", "", "-beta.1", "-rc2", ""]
+    buckets: dict[str, dict[str, list[Advisory]]] = {}
+    n_adv = 0
+    for bname, count in bucket_plan:
+        eco = bname.split("::", 1)[0]
+        pkgs_b: dict[str, list[Advisory]] = {}
+        for i in range(count):
+            lo = f"{(i % 9)}.{i % 10}.0{suffixes[i % len(suffixes)]}"
+            hi = f"{(i % 9) + 1}.{i % 10}.0"
+            pkgs_b.setdefault(f"{eco}-pkg-{i % (count // 2):05d}", []).append(
+                Advisory(
+                    vulnerability_id=f"CVE-2024-{n_adv:06d}",
+                    vulnerable_versions=[f">={lo}, <{hi}"],
+                    patched_versions=[hi],
+                )
             )
-        ]
-    db = VulnDB(buckets={"npm::bench": bucket}, details={})
+            n_adv += 1
+        buckets[bname] = pkgs_b
+    db = VulnDB(buckets=buckets, details={})
     pkgs = [
         Package(
-            name=f"pkg-{i % (2 * n_advisories):05d}",
-            version=f"{rng.integers(1, 10)}.{rng.integers(0, 10)}.{rng.integers(0, 10)}",
+            name=f"npm-pkg-{i % 15_000:05d}",
+            version=f"{rng.integers(1, 10)}.{rng.integers(0, 10)}."
+            f"{rng.integers(0, 10)}",
         )
         for i in range(n_pkgs)
     ]
@@ -193,8 +218,8 @@ def bench_cve(rng) -> dict:
         "metric": "cve_match_rate",
         "value": round(n_pkgs / dt, 0),
         "unit": "pkgs/s",
-        "detail": {"packages": n_pkgs, "advisories": n_advisories,
-                   "matches": len(vulns)},
+        "detail": {"packages": n_pkgs, "advisories": n_adv,
+                   "buckets": len(buckets), "matches": len(vulns)},
     }
 
 

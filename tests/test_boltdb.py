@@ -1,0 +1,226 @@
+"""trivy-db (bbolt) ingestion: file-format round-trip, bucket-name
+compatibility with the reference schema, enum normalization, lazy loading.
+
+Mirrors the reference's fake-DB technique (internal/dbtest/db.go builds a
+real bolt file from YAML fixtures); bucket names and value shapes follow
+the reference's own fixtures (pkg/detector/library/testdata/fixtures/
+pip.yaml, integration/testdata/fixtures/db/*.yaml).
+"""
+
+import json
+import os
+
+import pytest
+
+from trivy_tpu.db import VulnDB, load_default_db
+from trivy_tpu.db.bolt import BoltDB, BoltWriter
+from trivy_tpu.db.convert import convert_bolt
+from trivy_tpu.types import Application, Package
+
+
+def j(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def build_bolt(path):
+    """A trivy-db-shaped bolt file exercising OS + library buckets,
+    int-enum severity/status, data sources, and detail rows."""
+    BoltWriter().write(
+        path,
+        {
+            b"alpine 3.18": {
+                b"musl": {
+                    b"CVE-2023-0001": j({"FixedVersion": "1.2.4-r1"}),
+                },
+                b"busybox": {
+                    # Severity/Status int enums, as the real DB stores them
+                    b"CVE-2023-0002": j(
+                        {"FixedVersion": "1.36.1-r1", "Severity": 3}
+                    ),
+                    b"CVE-2023-0003": j({"FixedVersion": "", "Status": 2}),
+                },
+            },
+            b"debian 12": {
+                b"bash": {
+                    b"CVE-2022-3715": j({"Severity": 1, "Status": 7}),
+                },
+            },
+            b"pip::GitHub Security Advisory Pip": {
+                b"django": {
+                    b"CVE-2023-36053": j(
+                        {
+                            "PatchedVersions": ["4.2.3"],
+                            "VulnerableVersions": ["< 4.2.3"],
+                        }
+                    ),
+                },
+            },
+            b"npm::GitHub Security Advisory Npm": {
+                b"lodash": {
+                    b"CVE-2021-23337": j(
+                        {
+                            "PatchedVersions": ["4.17.21"],
+                            "VulnerableVersions": ["<4.17.21"],
+                        }
+                    ),
+                },
+            },
+            b"data-source": {
+                b"alpine 3.18": j(
+                    {"ID": "alpine", "Name": "Alpine Secdb", "URL": "https://a"}
+                ),
+                b"pip::GitHub Security Advisory Pip": j(
+                    {"ID": "ghsa", "Name": "GitHub Security Advisory Pip",
+                     "URL": "https://g"}
+                ),
+            },
+            b"vulnerability": {
+                b"CVE-2023-36053": j(
+                    {"Title": "django regex dos", "Severity": "HIGH"}
+                ),
+                b"CVE-2023-0001": j({"Title": "musl", "Severity": "MEDIUM"}),
+            },
+        },
+    )
+
+
+@pytest.fixture()
+def flat_db(tmp_path):
+    bolt_path = tmp_path / "trivy.db"
+    build_bolt(str(bolt_path))
+    out = tmp_path / "flat"
+    out.mkdir()
+    stats = convert_bolt(str(bolt_path), str(out))
+    db = VulnDB.load(str(out))
+    db.db_dir = str(out)
+    return db, stats
+
+
+def test_bolt_roundtrip_bucket_names(tmp_path):
+    path = tmp_path / "trivy.db"
+    build_bolt(str(path))
+    db = BoltDB(str(path))
+    names = sorted(b.decode() for b in db.buckets())
+    assert names == [
+        "alpine 3.18",
+        "data-source",
+        "debian 12",
+        "npm::GitHub Security Advisory Npm",
+        "pip::GitHub Security Advisory Pip",
+        "vulnerability",
+    ]
+
+
+def test_convert_stats_and_layout(flat_db, tmp_path):
+    db, stats = flat_db
+    assert stats["buckets"] == 4  # advisory buckets only
+    assert stats["advisories"] == 6
+    assert stats["details"] == 2
+    assert os.path.exists(os.path.join(db.db_dir, "manifest.json"))
+    assert os.path.exists(os.path.join(db.db_dir, "data-sources.json"))
+
+
+def test_lazy_os_bucket_lookup(flat_db):
+    db, _ = flat_db
+    advs = db.get_advisories("alpine 3.18", "musl")
+    assert len(advs) == 1
+    assert advs[0].vulnerability_id == "CVE-2023-0001"
+    assert advs[0].fixed_version == "1.2.4-r1"
+    # data source attached from the data-source bucket
+    assert advs[0].data_source.get("ID") == "alpine"
+
+
+def test_enum_normalization(flat_db):
+    db, _ = flat_db
+    busy = {a.vulnerability_id: a for a in db.get_advisories("alpine 3.18", "busybox")}
+    assert busy["CVE-2023-0002"].severity == "HIGH"  # int 3 -> HIGH
+    assert busy["CVE-2023-0003"].status == "affected"  # int 2 -> affected
+    bash = db.get_advisories("debian 12", "bash")
+    assert bash[0].severity == "LOW"  # int 1 -> LOW
+    assert bash[0].status == "end_of_life"  # int 7
+
+
+def test_library_detect_from_bolt(flat_db):
+    db, _ = flat_db
+    from trivy_tpu.detector import library
+
+    app = Application(
+        type="pip",
+        file_path="requirements.txt",
+        packages=[Package(name="Django", version="4.2.1")],
+    )
+    vulns = library.detect(db, app)
+    assert [v.vulnerability_id for v in vulns] == ["CVE-2023-36053"]
+    assert vulns[0].fixed_version == "4.2.3"
+
+    # npm ecosystem rides a different source bucket
+    app2 = Application(
+        type="npm",
+        file_path="package-lock.json",
+        packages=[Package(name="lodash", version="4.17.20")],
+    )
+    assert [v.vulnerability_id for v in library.detect(db, app2)] == [
+        "CVE-2021-23337"
+    ]
+
+
+def test_detail_shard_lookup(flat_db):
+    db, _ = flat_db
+    assert db.get_detail("CVE-2023-36053")["Title"] == "django regex dos"
+    assert db.get_detail("CVE-2023-0001")["Severity"] == "MEDIUM"
+    assert db.get_detail("CVE-9999-0000") == {}
+
+
+def test_load_default_db_auto_converts(tmp_path):
+    dbdir = tmp_path / "db"
+    dbdir.mkdir()
+    build_bolt(str(dbdir / "trivy.db"))
+    (dbdir / "metadata.json").write_text(
+        json.dumps({"Version": 2, "NextUpdate": "2999-01-01T00:00:00Z"})
+    )
+    db = load_default_db(str(dbdir), None)
+    assert db is not None
+    assert db.get_advisories("alpine 3.18", "musl")
+    # metadata rides along into the flattened dir
+    assert db.metadata.get("Version") == 2
+    # second load reuses the conversion (manifest newer than trivy.db)
+    db2 = load_default_db(str(dbdir), None)
+    assert db2.get_advisories("debian 12", "bash")
+
+
+def test_merged_prefix_index(flat_db):
+    db, _ = flat_db
+    idx = db.prefix_advisories("pip::")
+    assert set(idx) == {"django"}
+    assert idx["django"][0].vulnerability_id == "CVE-2023-36053"
+    # eager-mode DBs expose the same API
+    eager = VulnDB(
+        buckets={
+            "npm::a": {"x": []},
+            "npm::b": {"x": [], "y": []},
+        },
+        details={},
+    )
+    assert set(eager.prefix_advisories("npm::")) == {"x", "y"}
+
+
+def test_bolt_scale_branch_pages(tmp_path):
+    """A bucket large enough to need branch pages and overflow values."""
+    pkgs = {
+        f"pkg-{i:05d}".encode(): {
+            f"CVE-2024-{i:05d}".encode(): j(
+                {"FixedVersion": f"{i % 9}.{i % 10}.1"}
+            )
+        }
+        for i in range(3000)
+    }
+    path = tmp_path / "big.db"
+    BoltWriter().write(str(path), {b"debian 12": pkgs})
+    out = tmp_path / "flat"
+    out.mkdir()
+    stats = convert_bolt(str(path), str(out))
+    assert stats["advisories"] == 3000
+    db = VulnDB.load(str(out))
+    db.db_dir = str(out)
+    advs = db.get_advisories("debian 12", "pkg-02999")
+    assert advs[0].vulnerability_id == "CVE-2024-02999"

@@ -59,13 +59,150 @@ ECOSYSTEMS: dict[str, tuple[str, str]] = {
 BATCH_THRESHOLD = 512
 
 
+class _CompiledPrefix:
+    """Per-prefix constraint tables, parsed and encode-indexed once per DB
+    load (SURVEY §7: advisory boundary versions encode once per load; only
+    installed versions encode per scan). Constraint rows for every advisory
+    live in flat arrays; an advisory owns the contiguous row span
+    ``adv_span[id(adv)]`` so per-scan assembly is a vectorized ragged
+    gather instead of per-candidate array concatenation."""
+
+    def __init__(self, scheme: str):
+        self.scheme = scheme
+        self.bounds = None  # np.int32 [n_bounds, L] encoded boundary versions
+        self.ops_flat = None  # np.int32 [R] op codes
+        self.b_flat = None  # np.int32 [R] bound-matrix rows
+        self.glocal_flat = None  # np.int32 [R] local AND-group per row
+        # id(adv) -> (row_start, row_end, n_groups, empty_true, host_only)
+        self.adv_span: dict[int, tuple] = {}
+        self._bounds_dev: dict[int, object] = {}  # width -> device array
+
+    def bounds_device(self, width: int):
+        """Device-resident bound matrix at >= ``width`` columns, cached —
+        the static side of the CVE join stays in HBM across scans."""
+        import jax
+        import numpy as np
+
+        from trivy_tpu.version.encode import pad_value
+
+        w = max(width, self.bounds.shape[1])
+        if w not in self._bounds_dev:
+            mat = self.bounds
+            if mat.shape[1] < w:
+                out = np.full(
+                    (mat.shape[0], w), pad_value(self.scheme), dtype=np.int32
+                )
+                out[:, : mat.shape[1]] = mat
+                mat = out
+            self._bounds_dev[w] = jax.device_put(mat)
+        return self._bounds_dev[w]
+
+
+def _compile_prefix(index: dict, scheme: str) -> "_CompiledPrefix":
+    import numpy as np
+
+    from trivy_tpu.ops.verscmp import OPS
+    from trivy_tpu.version.encode import encode, pad_value
+
+    cp = _CompiledPrefix(scheme)
+    bound_rows: dict[str, int] = {}
+    encoded: list[list[int]] = []
+
+    def bound_idx(version: str) -> int | None:
+        if version in bound_rows:
+            return bound_rows[version]
+        r = encode(scheme, version)
+        if r is None:
+            return None
+        bound_rows[version] = len(encoded)
+        encoded.append(r)
+        return bound_rows[version]
+
+    ops_flat: list[int] = []
+    b_flat: list[int] = []
+    glocal_flat: list[int] = []
+
+    for advs in index.values():
+        for adv in advs:
+            if id(adv) in cp.adv_span:
+                continue
+            groups = _constraint_groups(adv)
+            start = len(ops_flat)
+            empty_true: tuple[int, ...] = ()
+            host_only = False
+            for gid, group in enumerate(groups):
+                if not group:
+                    empty_true += (gid,)
+                    continue
+                for c in group:
+                    bi = bound_idx(c.version)
+                    if bi is None:
+                        host_only = True
+                        break
+                    ops_flat.append(OPS[c.op])
+                    b_flat.append(bi)
+                    glocal_flat.append(gid)
+                if host_only:
+                    break
+            if host_only:
+                del ops_flat[start:], b_flat[start:], glocal_flat[start:]
+                cp.adv_span[id(adv)] = (0, 0, 0, (), True)
+            else:
+                cp.adv_span[id(adv)] = (
+                    start, len(ops_flat), len(groups), empty_true, False,
+                )
+    cp.ops_flat = np.asarray(ops_flat, dtype=np.int32)
+    cp.b_flat = np.asarray(b_flat, dtype=np.int32)
+    cp.glocal_flat = np.asarray(glocal_flat, dtype=np.int32)
+    if encoded:
+        L = max(len(r) for r in encoded)
+        mat = np.full((len(encoded), L), pad_value(scheme), dtype=np.int32)
+        for i, r in enumerate(encoded):
+            mat[i, : len(r)] = r
+        cp.bounds = mat
+    return cp
+
+
+def _ragged_arange(starts, lens):
+    """Vectorized concatenation of [np.arange(s, s+l) for s, l in zip(...)]."""
+    import numpy as np
+
+    total = int(lens.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    out = np.ones(total, dtype=np.int64)
+    out[0] = starts[0]
+    cum = np.cumsum(lens)[:-1]
+    out[cum] = starts[1:] - (starts[:-1] + lens[:-1] - 1)
+    return np.cumsum(out)
+
+
+def _constraint_groups(adv: Advisory) -> list[list[Constraint]]:
+    """OR-of-AND constraint groups for one advisory (trivy-db stores one
+    AND-group per VulnerableVersions entry; patched/fixed-only advisories
+    become one all-below-bounds group)."""
+    if adv.vulnerable_versions:
+        return [g for e in adv.vulnerable_versions for g in parse_constraints(e)]
+    bounds = list(adv.patched_versions)
+    if adv.fixed_version:
+        bounds.extend(x.strip() for x in adv.fixed_version.split(","))
+    return [[Constraint("<", _bound_version(b)) for b in bounds]] if bounds else []
+
+
 def detect(db, app: Application) -> list[DetectedVulnerability]:
     eco = ECOSYSTEMS.get(app.type)
     if eco is None:
         logger.debug("unsupported application type: %s", app.type)
         return []
     prefix, scheme = eco
-    buckets = db.buckets_with_prefix(f"{prefix}::")
+    # merged pkg->advisories index across every '<eco>::<source>' bucket:
+    # one dict probe per package, not one per (package x bucket) — a real
+    # trivy-db has dozens of source buckets per ecosystem
+    index = (
+        db.prefix_advisories(f"{prefix}::")
+        if hasattr(db, "prefix_advisories")
+        else None
+    )
 
     # host-side hash join: (pkg, advisory) candidate pairs
     candidates: list[tuple] = []
@@ -73,13 +210,35 @@ def detect(db, app: Application) -> list[DetectedVulnerability]:
         if not pkg.version:
             continue
         name = _normalize_name(prefix, pkg.name)
-        for bucket in buckets:
-            for adv in db.get_advisories(bucket, name):
+        if index is not None:
+            for adv in index.get(name, ()):
                 candidates.append((pkg, adv))
+        else:
+            for bucket in db.buckets_with_prefix(f"{prefix}::"):
+                for adv in db.get_advisories(bucket, name):
+                    candidates.append((pkg, adv))
 
     verdicts = None
     if len(app.packages) >= BATCH_THRESHOLD:
-        verdicts = _batch_verdicts(scheme, candidates)
+        compiled = None
+        if index is not None:
+            from trivy_tpu.version.encode import ENCODABLE
+
+            if scheme in ENCODABLE:
+                cache = getattr(db, "_lib_compiled", None)
+                if cache is None:
+                    cache = {}
+                    try:
+                        db._lib_compiled = cache
+                    except AttributeError:
+                        pass
+                compiled = cache.get(prefix)
+                if compiled is None:
+                    compiled = cache[prefix] = _compile_prefix(index, scheme)
+        if compiled is not None:
+            verdicts = _batch_verdicts_compiled(compiled, candidates)
+        else:
+            verdicts = _batch_verdicts(scheme, candidates)
 
     vulns: list[DetectedVulnerability] = []
     for i, (pkg, adv) in enumerate(candidates):
@@ -106,6 +265,108 @@ def detect(db, app: Application) -> list[DetectedVulnerability]:
             )
     vulns.sort(key=lambda v: (v.pkg_name, v.vulnerability_id, v.pkg_path))
     return vulns
+
+
+def _batch_verdicts_compiled(cp: _CompiledPrefix, candidates: list[tuple]) -> list[bool] | None:
+    """Device constraint evaluation against the pre-compiled prefix cache:
+    advisory bounds are already parsed + encoded, so the per-scan host work
+    is one encode per unique installed version, one scalar-append loop over
+    candidates, and vectorized ragged gathers for row assembly."""
+    import numpy as np
+
+    from trivy_tpu.version.encode import encode, pad_value
+
+    if not candidates:
+        return []
+    # one encode per unique installed version
+    inst_idx: dict[str, int | None] = {}
+    inst_rows: list[list[int]] = []
+
+    # per accepted candidate (scalar appends only)
+    c_idx: list[int] = []  # candidate index
+    c_start: list[int] = []  # flat row span
+    c_len: list[int] = []
+    c_groups: list[int] = []  # group count
+    c_arow: list[int] = []  # installed-version row
+    force_true: list[int] = []  # (global) trivially-true group ids
+    host_pairs: list[int] = []
+    n_groups = 0
+
+    for idx, (pkg, adv) in enumerate(candidates):
+        span = cp.adv_span.get(id(adv))
+        if span is None or span[4]:
+            host_pairs.append(idx)
+            continue
+        start, end, groups, empty_true, _ = span
+        if groups == 0:
+            continue  # no constraints -> not vulnerable
+        version = pkg.version
+        arow = inst_idx.get(version, -1)
+        if arow == -1:
+            r = encode(cp.scheme, version)
+            if r is None:
+                inst_idx[version] = None
+                host_pairs.append(idx)
+                continue
+            arow = len(inst_rows)
+            inst_idx[version] = arow
+            inst_rows.append(r)
+        elif arow is None:
+            host_pairs.append(idx)
+            continue
+        for g in empty_true:
+            force_true.append(n_groups + g)
+        c_idx.append(idx)
+        c_start.append(start)
+        c_len.append(end - start)
+        c_groups.append(groups)
+        c_arow.append(arow)
+        n_groups += groups
+
+    verdicts = [False] * len(candidates)
+    if n_groups:
+        group_ok = np.ones(n_groups, dtype=bool)
+        starts = np.asarray(c_start, dtype=np.int64)
+        lens = np.asarray(c_len, dtype=np.int64)
+        groups_np = np.asarray(c_groups, dtype=np.int64)
+        nz = lens > 0
+        if nz.any():
+            from trivy_tpu.ops.verscmp import check_ops_gather_bucketed
+
+            rows = _ragged_arange(starts[nz], lens[nz])
+            ops = cp.ops_flat[rows]
+            b_idx = cp.b_flat[rows]
+            a_idx = np.repeat(
+                np.asarray(c_arow, dtype=np.int32)[nz], lens[nz]
+            ).astype(np.int32)
+            # global group id = local group + this candidate's group base
+            group_base = np.concatenate(([0], np.cumsum(groups_np)[:-1]))
+            row_group = cp.glocal_flat[rows] + np.repeat(group_base[nz], lens[nz])
+            La = max(len(r) for r in inst_rows)
+            pv = pad_value(cp.scheme)
+            Lb = cp.bounds.shape[1] if cp.bounds is not None else 1
+            L = max(La, Lb)
+            # width buckets of 8 keep inst widths from fragmenting compiles
+            L = -(-L // 8) * 8
+            inst_mat = np.full((len(inst_rows), L), pv, dtype=np.int32)
+            for i, r in enumerate(inst_rows):
+                inst_mat[i, : len(r)] = r
+            ok = check_ops_gather_bucketed(
+                inst_mat, cp.bounds_device(L), a_idx, b_idx, ops
+            )
+            np.logical_and.at(group_ok, row_group, ok)
+        # empty AND-groups are trivially satisfied, even if another group
+        # of the same advisory evaluated false
+        if force_true:
+            group_ok[np.asarray(force_true)] = True
+        # candidate is vulnerable when any of its groups holds
+        group_pair = np.repeat(np.asarray(c_idx, dtype=np.int64), groups_np)
+        for idx in np.unique(group_pair[group_ok]):
+            verdicts[idx] = True
+    for idx in host_pairs:
+        pkg, adv = candidates[idx]
+        verdicts[idx] = _is_vulnerable(cp.scheme, pkg.version, adv)
+    return verdicts
 
 
 def _batch_verdicts(scheme: str, candidates: list[tuple]) -> list[bool] | None:
@@ -136,17 +397,7 @@ def _batch_verdicts(scheme: str, candidates: list[tuple]) -> list[bool] | None:
     pair_has_group: list[list[int]] = []
     for idx, (pkg, adv) in enumerate(candidates):
         groups_for_pair: list[int] = []
-        exprs = adv.vulnerable_versions
-        if exprs:
-            parsed = [g for e in exprs for g in parse_constraints(e)]
-        else:
-            # patched/fixed-only advisories: vulnerable iff below every bound
-            bounds = list(adv.patched_versions)
-            if adv.fixed_version:
-                bounds.extend(x.strip() for x in adv.fixed_version.split(","))
-            parsed = (
-                [[Constraint("<", _bound_version(b)) for b in bounds]] if bounds else []
-            )
+        parsed = _constraint_groups(adv)
         for group in parsed:
             gid = n_groups
             n_groups += 1

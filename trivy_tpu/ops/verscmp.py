@@ -36,6 +36,56 @@ def check_ops(a: jax.Array, b: jax.Array, ops: jax.Array) -> jax.Array:
     )[jnp.arange(s.shape[0]), ops]
 
 
+@jax.jit
+def check_ops_gather(
+    inst: jax.Array, bounds: jax.Array, a_idx: jax.Array, b_idx: jax.Array,
+    ops: jax.Array,
+) -> jax.Array:
+    """``inst[a_idx] <op> bounds[b_idx]`` per row -> bool [R].
+
+    The gather runs on device so the static advisory-bound matrix stays
+    HBM-resident across scans; per scan only the (tiny) unique-installed
+    matrix and the int32 index/op rows cross the link — the layout SURVEY
+    §7 calls for (hot shards device-resident, host ships indices).
+    """
+    a = jnp.take(inst, a_idx, axis=0)
+    b = jnp.take(bounds, b_idx, axis=0)
+    s = lexcmp(a, b)
+    return jnp.stack(
+        [s < 0, s <= 0, s > 0, s >= 0, s == 0, s != 0], axis=1
+    )[jnp.arange(s.shape[0]), ops]
+
+
+def _next_bucket(n: int, floor: int = 256) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def check_ops_gather_bucketed(
+    inst: np.ndarray, bounds_dev, a_idx: np.ndarray, b_idx: np.ndarray,
+    ops: np.ndarray,
+) -> np.ndarray:
+    """Host wrapper padding the row count and inst rows to bucket shapes so
+    every dispatch hits a cached compilation."""
+    R = len(a_idx)
+    Rb = _next_bucket(R)
+    Ni = inst.shape[0]
+    Nib = _next_bucket(Ni, 64)
+    if Nib != Ni:
+        inst = np.concatenate(
+            [inst, np.zeros((Nib - Ni, inst.shape[1]), dtype=inst.dtype)]
+        )
+    if Rb != R:
+        pad = Rb - R
+        a_idx = np.concatenate([a_idx, np.zeros(pad, dtype=a_idx.dtype)])
+        b_idx = np.concatenate([b_idx, np.zeros(pad, dtype=b_idx.dtype)])
+        ops = np.concatenate([ops, np.zeros(pad, dtype=ops.dtype)])
+    out = np.asarray(check_ops_gather(inst, bounds_dev, a_idx, b_idx, ops))
+    return out[:R]
+
+
 def batch_compare(scheme: str, pairs: list[tuple[str, str]]) -> np.ndarray | None:
     """Compare many (a, b) version pairs on device; None if un-encodable."""
     from trivy_tpu.version.encode import encode_batch

@@ -9,10 +9,12 @@ non-letters and ``~`` sorts before everything including end-of-string.
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 
 _VALID = re.compile(r"^(?:\d+:)?[0-9][A-Za-z0-9.+:~-]*$|^(?:\d+:)?[0-9]$|^[0-9]+$")
 
 
+@lru_cache(maxsize=65536)
 def parse(v: str) -> tuple[int, str, str]:
     """-> (epoch, upstream, revision)."""
     v = v.strip()

@@ -9,10 +9,12 @@ after the base version but before a longer normal suffix.
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 
 _SEG = re.compile(r"([0-9]+|[a-zA-Z]+|~|\^)")
 
 
+@lru_cache(maxsize=65536)
 def parse(v: str) -> tuple[int, str, str]:
     v = v.strip()
     epoch = 0

@@ -10,10 +10,12 @@ extra numeric components preserved for compare.
 from __future__ import annotations
 
 import re
+from functools import lru_cache
 
 _NUM = re.compile(r"^\d+$")
 
 
+@lru_cache(maxsize=65536)
 def parse(v: str):
     """-> (nums tuple, prerelease tuple, had_prerelease)."""
     v = v.strip().lstrip("vV")
