@@ -261,16 +261,36 @@ def load_default_db(db_repository: str | None, cache_dir: str | None) -> VulnDB 
         bolt_path = os.path.join(cand, "trivy.db")
         flat_dir = os.path.join(cand, "flattened")
         if os.path.exists(bolt_path):
-            if not os.path.exists(os.path.join(flat_dir, "manifest.json")) or (
-                os.path.getmtime(bolt_path)
-                > os.path.getmtime(os.path.join(flat_dir, "manifest.json"))
-            ):
-                from trivy_tpu.db.convert import convert_bolt
+            # a corrupt/truncated trivy.db degrades to the next candidate
+            # (or a no-DB scan), never a crashed scan
+            try:
+                if not os.path.exists(os.path.join(flat_dir, "manifest.json")) or (
+                    os.path.getmtime(bolt_path)
+                    > os.path.getmtime(os.path.join(flat_dir, "manifest.json"))
+                ):
+                    from trivy_tpu.db.convert import convert_bolt
 
-                logger.info("flattening %s (first use)", bolt_path)
-                os.makedirs(flat_dir, exist_ok=True)
-                convert_bolt(bolt_path, flat_dir)
-            db = VulnDB.load(flat_dir)
+                    logger.info("flattening %s (first use)", bolt_path)
+                    # convert into a scratch dir, then swap: a crashed or
+                    # concurrent conversion can't leave a half-written
+                    # flattened dir that a later load trusts
+                    tmp_dir = f"{flat_dir}.tmp{os.getpid()}"
+                    os.makedirs(tmp_dir, exist_ok=True)
+                    convert_bolt(bolt_path, tmp_dir)
+                    import shutil
+
+                    old = f"{flat_dir}.old{os.getpid()}"
+                    if os.path.exists(flat_dir):
+                        os.rename(flat_dir, old)
+                        shutil.rmtree(old, ignore_errors=True)
+                    os.rename(tmp_dir, flat_dir)
+                db = VulnDB.load(flat_dir)
+            except Exception as e:
+                logger.warning(
+                    "cannot use advisory DB %s (%s: %s); continuing without it",
+                    bolt_path, type(e).__name__, e,
+                )
+                continue
             if db.is_stale():
                 logger.warning(
                     "advisory DB at %s is stale (NextUpdate %s has passed); "

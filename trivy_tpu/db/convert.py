@@ -91,8 +91,13 @@ def detail_shard(vuln_id: str) -> str:
 def convert_bolt(bolt_path: str, out_dir: str) -> dict:
     """Flatten one trivy-db bbolt file; returns conversion stats."""
     db = BoltDB(bolt_path)
-    os.makedirs(os.path.join(out_dir, "advisories"), exist_ok=True)
-    os.makedirs(os.path.join(out_dir, "vulnerability"), exist_ok=True)
+    # idempotent: stale shards from a previous conversion must not merge
+    # into (or outlive) this one — entries removed upstream stay removed
+    for sub in ("advisories", "vulnerability"):
+        path = os.path.join(out_dir, sub)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        os.makedirs(path, exist_ok=True)
 
     manifest: dict[str, str] = {}
     n_advisories = 0

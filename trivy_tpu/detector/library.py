@@ -163,18 +163,6 @@ def _compile_prefix(index: dict, scheme: str) -> "_CompiledPrefix":
     return cp
 
 
-def _ragged_arange(starts, lens):
-    """Vectorized concatenation of [np.arange(s, s+l) for s, l in zip(...)]."""
-    import numpy as np
-
-    total = int(lens.sum())
-    if total == 0:
-        return np.zeros(0, dtype=np.int64)
-    out = np.ones(total, dtype=np.int64)
-    out[0] = starts[0]
-    cum = np.cumsum(lens)[:-1]
-    out[cum] = starts[1:] - (starts[:-1] + lens[:-1] - 1)
-    return np.cumsum(out)
 
 
 def _constraint_groups(adv: Advisory) -> list[list[Constraint]]:
@@ -288,7 +276,6 @@ def _batch_verdicts_compiled(cp: _CompiledPrefix, candidates: list[tuple]) -> li
     c_len: list[int] = []
     c_groups: list[int] = []  # group count
     c_arow: list[int] = []  # installed-version row
-    force_true: list[int] = []  # (global) trivially-true group ids
     host_pairs: list[int] = []
     n_groups = 0
 
@@ -297,7 +284,7 @@ def _batch_verdicts_compiled(cp: _CompiledPrefix, candidates: list[tuple]) -> li
         if span is None or span[4]:
             host_pairs.append(idx)
             continue
-        start, end, groups, empty_true, _ = span
+        start, end, groups, _empty_true, _ = span
         if groups == 0:
             continue  # no constraints -> not vulnerable
         version = pkg.version
@@ -314,8 +301,6 @@ def _batch_verdicts_compiled(cp: _CompiledPrefix, candidates: list[tuple]) -> li
         elif arow is None:
             host_pairs.append(idx)
             continue
-        for g in empty_true:
-            force_true.append(n_groups + g)
         c_idx.append(idx)
         c_start.append(start)
         c_len.append(end - start)
@@ -325,15 +310,18 @@ def _batch_verdicts_compiled(cp: _CompiledPrefix, candidates: list[tuple]) -> li
 
     verdicts = [False] * len(candidates)
     if n_groups:
+        # empty AND-groups stay True through np.ones + contributing no rows
+        # to the logical_and reduction — trivially satisfied
         group_ok = np.ones(n_groups, dtype=bool)
         starts = np.asarray(c_start, dtype=np.int64)
         lens = np.asarray(c_len, dtype=np.int64)
         groups_np = np.asarray(c_groups, dtype=np.int64)
         nz = lens > 0
         if nz.any():
+            from trivy_tpu.ops.ragged import ragged_arange
             from trivy_tpu.ops.verscmp import check_ops_gather_bucketed
 
-            rows = _ragged_arange(starts[nz], lens[nz])
+            rows = ragged_arange(starts[nz], lens[nz])
             ops = cp.ops_flat[rows]
             b_idx = cp.b_flat[rows]
             a_idx = np.repeat(
@@ -355,10 +343,6 @@ def _batch_verdicts_compiled(cp: _CompiledPrefix, candidates: list[tuple]) -> li
                 inst_mat, cp.bounds_device(L), a_idx, b_idx, ops
             )
             np.logical_and.at(group_ok, row_group, ok)
-        # empty AND-groups are trivially satisfied, even if another group
-        # of the same advisory evaluated false
-        if force_true:
-            group_ok[np.asarray(force_true)] = True
         # candidate is vulnerable when any of its groups holds
         group_pair = np.repeat(np.asarray(c_idx, dtype=np.int64), groups_np)
         for idx in np.unique(group_pair[group_ok]):
