@@ -166,7 +166,10 @@ def test_k8s_hardened_pod_mostly_passes():
 kind: Pod
 metadata:
   name: good
+  annotations:
+    container.apparmor.security.beta.kubernetes.io/app: runtime/default
 spec:
+  automountServiceAccountToken: false
   containers:
   - name: app
     image: nginx:1.25.3

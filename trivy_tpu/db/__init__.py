@@ -268,17 +268,27 @@ def load_default_db(db_repository: str | None, cache_dir: str | None) -> VulnDB 
                     os.path.getmtime(bolt_path)
                     > os.path.getmtime(os.path.join(flat_dir, "manifest.json"))
                 ):
+                    import glob
+                    import shutil
+
                     from trivy_tpu.db.convert import convert_bolt
 
                     logger.info("flattening %s (first use)", bolt_path)
+                    # stale scratch dirs from crashed prior runs (any pid)
+                    for stale in glob.glob(f"{flat_dir}.tmp*") + glob.glob(
+                        f"{flat_dir}.old*"
+                    ):
+                        shutil.rmtree(stale, ignore_errors=True)
                     # convert into a scratch dir, then swap: a crashed or
                     # concurrent conversion can't leave a half-written
                     # flattened dir that a later load trusts
                     tmp_dir = f"{flat_dir}.tmp{os.getpid()}"
                     os.makedirs(tmp_dir, exist_ok=True)
-                    convert_bolt(bolt_path, tmp_dir)
-                    import shutil
-
+                    try:
+                        convert_bolt(bolt_path, tmp_dir)
+                    except Exception:
+                        shutil.rmtree(tmp_dir, ignore_errors=True)
+                        raise
                     old = f"{flat_dir}.old{os.getpid()}"
                     if os.path.exists(flat_dir):
                         os.rename(flat_dir, old)

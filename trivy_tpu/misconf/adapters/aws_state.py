@@ -88,6 +88,7 @@ class Volume(Res):
 @dataclass
 class RDSInstance(Res):
     storage_encrypted: Val = field(default_factory=_v)
+    iam_auth: Val = field(default_factory=_v)
     publicly_accessible: Val = field(default_factory=_v)
     backup_retention: Val = field(default_factory=_v)
     performance_insights: Val = field(default_factory=_v)
@@ -201,6 +202,8 @@ class LambdaFunction(Res):
 
 @dataclass
 class AWSState:
+    provider = "aws"
+
     s3_buckets: list[S3Bucket] = field(default_factory=list)
     security_groups: list[SecurityGroup] = field(default_factory=list)
     instances: list[Instance] = field(default_factory=list)
@@ -222,3 +225,137 @@ class AWSState:
     dynamodb_tables: list[DynamoDBTable] = field(default_factory=list)
     cloudfront_distributions: list[CloudFrontDistribution] = field(default_factory=list)
     lambda_functions: list[LambdaFunction] = field(default_factory=list)
+    api_gateway_stages: list["APIGatewayStage"] = field(default_factory=list)
+    athena_workgroups: list["AthenaWorkgroup"] = field(default_factory=list)
+    codebuild_projects: list["CodeBuildProject"] = field(default_factory=list)
+    docdb_clusters: list["DocDBCluster"] = field(default_factory=list)
+    ecs_task_definitions: list["ECSTaskDefinition"] = field(default_factory=list)
+    ecs_clusters: list["ECSCluster"] = field(default_factory=list)
+    elasticsearch_domains: list["ESDomain"] = field(default_factory=list)
+    kinesis_streams: list["KinesisStream"] = field(default_factory=list)
+    mq_brokers: list["MQBroker"] = field(default_factory=list)
+    msk_clusters: list["MSKCluster"] = field(default_factory=list)
+    neptune_clusters: list["NeptuneCluster"] = field(default_factory=list)
+    aws_workspaces: list["Workspace"] = field(default_factory=list)
+    launch_templates: list["LaunchTemplate"] = field(default_factory=list)
+    log_groups: list["LogGroup"] = field(default_factory=list)
+    api_gateway_domains: list["APIGatewayDomain"] = field(default_factory=list)
+    rds_clusters: list["RDSCluster"] = field(default_factory=list)
+    secretsmanager_secrets: list["SecretsManagerSecret"] = field(default_factory=list)
+    dax_clusters: list["DAXCluster"] = field(default_factory=list)
+    ebs_default_encryption: list["EBSDefaultEncryption"] = field(default_factory=list)
+
+
+# -- round-4 service breadth (ref: pkg/iac/providers/aws/* service models) ----
+
+@dataclass
+class APIGatewayStage(Res):
+    name: Val = field(default_factory=_v)
+    access_logging: Val = field(default_factory=_v)
+    xray_tracing: Val = field(default_factory=_v)
+
+
+@dataclass
+class AthenaWorkgroup(Res):
+    encryption_enabled: Val = field(default_factory=_v)
+    enforce_configuration: Val = field(default_factory=_v)
+
+
+@dataclass
+class CodeBuildProject(Res):
+    artifact_encryption_disabled: list[Val] = field(default_factory=list)
+
+
+@dataclass
+class DocDBCluster(Res):
+    storage_encrypted: Val = field(default_factory=_v)
+    kms_key_id: Val = field(default_factory=_v)
+    log_exports: list[Val] = field(default_factory=list)
+
+
+@dataclass
+class ECSTaskDefinition(Res):
+    container_definitions: Val = field(default_factory=_v)  # parsed JSON
+
+
+@dataclass
+class ECSCluster(Res):
+    container_insights: Val = field(default_factory=_v)
+
+
+@dataclass
+class ESDomain(Res):
+    encrypt_at_rest: Val = field(default_factory=_v)
+    node_to_node_encryption: Val = field(default_factory=_v)
+    enforce_https: Val = field(default_factory=_v)
+    tls_policy: Val = field(default_factory=_v)
+    audit_logging: Val = field(default_factory=_v)
+
+
+@dataclass
+class KinesisStream(Res):
+    encryption_type: Val = field(default_factory=_v)
+    kms_key_id: Val = field(default_factory=_v)
+
+
+@dataclass
+class MQBroker(Res):
+    publicly_accessible: Val = field(default_factory=_v)
+    general_logging: Val = field(default_factory=_v)
+    audit_logging: Val = field(default_factory=_v)
+
+
+@dataclass
+class MSKCluster(Res):
+    client_broker_encryption: Val = field(default_factory=_v)
+    logging_enabled: Val = field(default_factory=_v)
+
+
+@dataclass
+class NeptuneCluster(Res):
+    storage_encrypted: Val = field(default_factory=_v)
+    kms_key_id: Val = field(default_factory=_v)
+    log_exports: list[Val] = field(default_factory=list)
+
+
+@dataclass
+class Workspace(Res):
+    root_volume_encrypted: Val = field(default_factory=_v)
+    user_volume_encrypted: Val = field(default_factory=_v)
+
+
+@dataclass
+class LaunchTemplate(Res):
+    http_tokens: Val = field(default_factory=_v)
+
+
+@dataclass
+class LogGroup(Res):
+    kms_key_id: Val = field(default_factory=_v)
+    retention_days: Val = field(default_factory=_v)
+
+
+@dataclass
+class APIGatewayDomain(Res):
+    security_policy: Val = field(default_factory=_v)
+
+
+@dataclass
+class RDSCluster(Res):
+    storage_encrypted: Val = field(default_factory=_v)
+    backup_retention: Val = field(default_factory=_v)
+
+
+@dataclass
+class SecretsManagerSecret(Res):
+    kms_key_id: Val = field(default_factory=_v)
+
+
+@dataclass
+class DAXCluster(Res):
+    sse_enabled: Val = field(default_factory=_v)
+
+
+@dataclass
+class EBSDefaultEncryption(Res):
+    enabled: Val = field(default_factory=_v)

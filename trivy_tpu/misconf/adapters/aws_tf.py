@@ -50,6 +50,8 @@ def adapt(resources: list[BlockVal]) -> S.AWSState:
     _adapt_iam(by_type, st)
     _adapt_eks(by_type, st)
     _adapt_misc(by_type, st)
+    _adapt_breadth(by_type, st)
+    _adapt_breadth2(by_type, st)
     return st
 
 
@@ -235,6 +237,7 @@ def _adapt_rds(by_type, st: S.AWSState):
             S.RDSInstance(
                 resource=bv,
                 storage_encrypted=bv.get("storage_encrypted", False),
+                iam_auth=bv.get("iam_database_authentication_enabled", False),
                 publicly_accessible=bv.get("publicly_accessible", False),
                 backup_retention=bv.get("backup_retention_period", 0),
                 performance_insights=bv.get("performance_insights_enabled", False),
@@ -439,3 +442,214 @@ def _adapt_misc(by_type, st: S.AWSState):
             else default_val("PassThrough", bv)
         )
         st.lambda_functions.append(f)
+
+
+def _adapt_breadth(by_type, st: S.AWSState):
+    """Round-4 service breadth: api gateway, athena, codebuild, docdb, ecs,
+    elasticsearch/opensearch, kinesis, mq, msk, neptune, workspaces, launch
+    templates (ref: pkg/iac/adapters/terraform/aws/* per service)."""
+    for rtype in ("aws_api_gateway_stage", "aws_apigatewayv2_stage"):
+        for bv in by_type.get(rtype, []):
+            stg = S.APIGatewayStage(resource=bv)
+            stg.name = bv.get("stage_name", bv.get("name").value)
+            al = bv.block("access_log_settings")
+            stg.access_logging = (
+                default_val(True, al) if al is not None else default_val(False, bv)
+            )
+            stg.xray_tracing = bv.get("xray_tracing_enabled", False)
+            st.api_gateway_stages.append(stg)
+
+    for bv in by_type.get("aws_athena_workgroup", []):
+        wg = S.AthenaWorkgroup(resource=bv)
+        cfg = bv.block("configuration")
+        wg.enforce_configuration = (
+            cfg.get("enforce_workgroup_configuration", True)
+            if cfg is not None
+            else default_val(True, bv)
+        )
+        enc = None
+        if cfg is not None:
+            rc = cfg.block("result_configuration")
+            if rc is not None:
+                enc = rc.block("encryption_configuration")
+        wg.encryption_enabled = (
+            default_val(True, enc) if enc is not None else default_val(False, bv)
+        )
+        st.athena_workgroups.append(wg)
+
+    for bv in by_type.get("aws_codebuild_project", []):
+        p = S.CodeBuildProject(resource=bv)
+        for art in bv.blocks("artifacts") + bv.blocks("secondary_artifacts"):
+            v = art.get("encryption_disabled", False)
+            if v.bool():
+                p.artifact_encryption_disabled.append(v)
+        st.codebuild_projects.append(p)
+
+    for bv in by_type.get("aws_docdb_cluster", []):
+        c = S.DocDBCluster(resource=bv)
+        c.storage_encrypted = bv.get("storage_encrypted", False)
+        c.kms_key_id = bv.get("kms_key_id")
+        exp = bv.get("enabled_cloudwatch_logs_exports")
+        if isinstance(exp.value, list):
+            c.log_exports = [exp.with_value(x) for x in exp.value]
+        st.docdb_clusters.append(c)
+
+    for bv in by_type.get("aws_ecs_task_definition", []):
+        td = S.ECSTaskDefinition(resource=bv)
+        cd = bv.get("container_definitions")
+        if isinstance(cd.value, str):
+            try:
+                td.container_definitions = cd.with_value(json.loads(cd.value))
+            except ValueError:
+                td.container_definitions = cd
+        else:
+            td.container_definitions = cd
+        st.ecs_task_definitions.append(td)
+
+    for bv in by_type.get("aws_ecs_cluster", []):
+        c = S.ECSCluster(resource=bv)
+        c.container_insights = default_val(False, bv)
+        for s_bv in bv.blocks("setting"):
+            if s_bv.get("name").str() == "containerInsights":
+                val = s_bv.get("value")
+                c.container_insights = val.with_value(
+                    val.str() in ("enabled", "enhanced")
+                )
+        st.ecs_clusters.append(c)
+
+    for rtype in ("aws_elasticsearch_domain", "aws_opensearch_domain"):
+        for bv in by_type.get(rtype, []):
+            d = S.ESDomain(resource=bv)
+            ear = bv.block("encrypt_at_rest")
+            d.encrypt_at_rest = (
+                ear.get("enabled", False) if ear is not None
+                else default_val(False, bv)
+            )
+            n2n = bv.block("node_to_node_encryption")
+            d.node_to_node_encryption = (
+                n2n.get("enabled", False) if n2n is not None
+                else default_val(False, bv)
+            )
+            dep = bv.block("domain_endpoint_options")
+            if dep is not None:
+                d.enforce_https = dep.get("enforce_https", False)
+                d.tls_policy = dep.get("tls_security_policy", "Policy-Min-TLS-1-0-2019-07")
+            else:
+                d.enforce_https = default_val(False, bv)
+                d.tls_policy = default_val("Policy-Min-TLS-1-0-2019-07", bv)
+            d.audit_logging = default_val(False, bv)
+            for lp in bv.blocks("log_publishing_options"):
+                if lp.get("log_type").str() == "AUDIT_LOGS":
+                    d.audit_logging = lp.get("enabled", True)
+            st.elasticsearch_domains.append(d)
+
+    for bv in by_type.get("aws_kinesis_stream", []):
+        k = S.KinesisStream(resource=bv)
+        k.encryption_type = bv.get("encryption_type", "NONE")
+        k.kms_key_id = bv.get("kms_key_id")
+        st.kinesis_streams.append(k)
+
+    for bv in by_type.get("aws_mq_broker", []):
+        b = S.MQBroker(resource=bv)
+        b.publicly_accessible = bv.get("publicly_accessible", False)
+        logs = bv.block("logs")
+        if logs is not None:
+            b.general_logging = logs.get("general", False)
+            b.audit_logging = logs.get("audit", False)
+        else:
+            b.general_logging = default_val(False, bv)
+            b.audit_logging = default_val(False, bv)
+        st.mq_brokers.append(b)
+
+    for bv in by_type.get("aws_msk_cluster", []):
+        c = S.MSKCluster(resource=bv)
+        c.client_broker_encryption = default_val("TLS_PLAINTEXT", bv)
+        enc = bv.block("encryption_info")
+        if enc is not None:
+            tr = enc.block("encryption_in_transit")
+            if tr is not None:
+                c.client_broker_encryption = tr.get("client_broker", "TLS")
+        c.logging_enabled = default_val(False, bv)
+        li = bv.block("logging_info")
+        if li is not None:
+            bl = li.block("broker_logs")
+            if bl is not None:
+                for kind in ("cloudwatch_logs", "firehose", "s3"):
+                    kb = bl.block(kind)
+                    if kb is not None and kb.get("enabled", False).bool():
+                        c.logging_enabled = kb.get("enabled")
+        st.msk_clusters.append(c)
+
+    for bv in by_type.get("aws_neptune_cluster", []):
+        n = S.NeptuneCluster(resource=bv)
+        n.storage_encrypted = bv.get("storage_encrypted", False)
+        n.kms_key_id = bv.get("kms_key_arn")
+        exp = bv.get("enable_cloudwatch_logs_exports")
+        if isinstance(exp.value, list):
+            n.log_exports = [exp.with_value(x) for x in exp.value]
+        st.neptune_clusters.append(n)
+
+    for bv in by_type.get("aws_workspaces_workspace", []):
+        w = S.Workspace(resource=bv)
+        w.root_volume_encrypted = bv.get("root_volume_encryption_enabled", False)
+        w.user_volume_encrypted = bv.get("user_volume_encryption_enabled", False)
+        st.aws_workspaces.append(w)
+
+    # launch templates and the legacy launch configurations share the
+    # metadata_options surface
+    for rtype in ("aws_launch_template",):
+        for bv in by_type.get(rtype, []):
+            st.launch_templates.append(_adapt_launch_metadata(bv))
+
+
+def _adapt_launch_metadata(bv) -> S.LaunchTemplate:
+    lt = S.LaunchTemplate(resource=bv)
+    mo = bv.block("metadata_options")
+    lt.http_tokens = (
+        mo.get("http_tokens", "optional") if mo is not None
+        else default_val("optional", bv)
+    )
+    return lt
+
+
+def _adapt_breadth2(by_type, st: S.AWSState):
+    """Second breadth wave: log groups, api gateway domains, rds clusters,
+    secretsmanager, launch configurations, dax, ebs default encryption."""
+    for bv in by_type.get("aws_cloudwatch_log_group", []):
+        lg = S.LogGroup(resource=bv)
+        lg.kms_key_id = bv.get("kms_key_id")
+        lg.retention_days = bv.get("retention_in_days", 0)
+        st.log_groups.append(lg)
+
+    for bv in by_type.get("aws_api_gateway_domain_name", []):
+        d = S.APIGatewayDomain(resource=bv)
+        d.security_policy = bv.get("security_policy", "TLS_1_0")
+        st.api_gateway_domains.append(d)
+
+    for bv in by_type.get("aws_rds_cluster", []):
+        c = S.RDSCluster(resource=bv)
+        c.storage_encrypted = bv.get("storage_encrypted", False)
+        c.backup_retention = bv.get("backup_retention_period", 1)
+        st.rds_clusters.append(c)
+
+    for bv in by_type.get("aws_secretsmanager_secret", []):
+        sec = S.SecretsManagerSecret(resource=bv)
+        sec.kms_key_id = bv.get("kms_key_id")
+        st.secretsmanager_secrets.append(sec)
+
+    for bv in by_type.get("aws_launch_configuration", []):
+        st.launch_templates.append(_adapt_launch_metadata(bv))
+
+    for bv in by_type.get("aws_dax_cluster", []):
+        d = S.DAXCluster(resource=bv)
+        sse = bv.block("server_side_encryption")
+        d.sse_enabled = (
+            sse.get("enabled", False) if sse is not None
+            else default_val(False, bv)
+        )
+        st.dax_clusters.append(d)
+
+    for bv in by_type.get("aws_ebs_encryption_by_default", []):
+        st.ebs_default_encryption.append(
+            S.EBSDefaultEncryption(resource=bv, enabled=bv.get("enabled", True))
+        )

@@ -558,11 +558,53 @@ class AzKeyVault(AzRes):
 
 
 @dataclass
+class AzAKSCluster(AzRes):
+    rbac_enabled: Val = field(default_factory=_v)
+    network_policy: Val = field(default_factory=_v)
+    private_cluster: Val = field(default_factory=_v)
+    authorized_ip_ranges: Val = field(default_factory=_v)  # list
+    logging_enabled: Val = field(default_factory=_v)
+
+
+@dataclass
+class AzSQLServer(AzRes):
+    auditing_enabled: Val = field(default_factory=_v)
+    audit_retention_days: Val = field(default_factory=_v)
+    public_network_access: Val = field(default_factory=_v)
+    min_tls: Val = field(default_factory=_v)
+    firewall_open_to_internet: list[Val] = field(default_factory=list)
+    ssl_enforce: Val = field(default_factory=_v)  # postgres/mysql flavors
+    flavor: str = "mssql"  # mssql | postgresql | mysql
+
+
+@dataclass
+class AzAppService(AzRes):
+    https_only: Val = field(default_factory=_v)
+    min_tls: Val = field(default_factory=_v)
+    client_cert: Val = field(default_factory=_v)
+    http2: Val = field(default_factory=_v)
+    identity: Val = field(default_factory=_v)
+
+
+@dataclass
+class AzKeyVaultObject(AzRes):
+    kind: str = "secret"  # secret | key
+    expiry_set: Val = field(default_factory=_v)
+    content_type: Val = field(default_factory=_v)
+
+
+@dataclass
 class AzureState:
+    provider = "azure"
+
     az_storage_accounts: list[AzStorageAccount] = field(default_factory=list)
     az_nsg_rules: list[AzNSGRule] = field(default_factory=list)
     az_virtual_machines: list[AzVM] = field(default_factory=list)
     az_key_vaults: list[AzKeyVault] = field(default_factory=list)
+    az_aks_clusters: list[AzAKSCluster] = field(default_factory=list)
+    az_sql_servers: list[AzSQLServer] = field(default_factory=list)
+    az_app_services: list[AzAppService] = field(default_factory=list)
+    az_key_vault_objects: list[AzKeyVaultObject] = field(default_factory=list)
 
 
 def _props(block: BlockVal) -> BlockVal:
@@ -717,7 +759,7 @@ def _check(id_, title, severity, service, targets, desc="", res=""):
                 avd_id=id_,
                 title=title,
                 severity=severity,
-                file_types=(FILE_TYPE,),
+                file_types=(FILE_TYPE, "terraform"),
                 fn=fn,
                 description=desc,
                 resolution=res,

@@ -113,6 +113,9 @@ def _load_builtins() -> None:
         _loaded = True
         import trivy_tpu.misconf.arm  # noqa: F401  (azure cloud checks)
         import trivy_tpu.misconf.checks.cloud_aws  # noqa: F401
+        import trivy_tpu.misconf.checks.cloud_azure  # noqa: F401
+        import trivy_tpu.misconf.checks.cloud_github  # noqa: F401
+        import trivy_tpu.misconf.checks.cloud_google  # noqa: F401
         import trivy_tpu.misconf.checks.docker  # noqa: F401
         import trivy_tpu.misconf.checks.kubernetes  # noqa: F401
 
@@ -198,11 +201,16 @@ def evaluate_cloud(
     out: dict[str, Misconfiguration] = {
         f: Misconfiguration(file_type=file_type, file_path=f) for f in files
     }
+    state_provider = getattr(state, "provider", "")
+    # plan JSON evaluates the terraform check set but keeps its own label
+    match_type = "terraform" if file_type == "terraformplan-json" else file_type
     for check in cloud_checks():
         if not enabled(check):
             continue
-        if check.file_types and file_type not in check.file_types:
+        if check.file_types and match_type not in check.file_types:
             continue  # check routed to other IaC types
+        if state_provider and check.provider and check.provider != state_provider:
+            continue  # check belongs to another cloud provider's state
         if check.targets and not getattr(state, check.targets, None):
             continue  # no matching resources: check not evaluated (no PASS noise)
         failures = list(check.fn(state))

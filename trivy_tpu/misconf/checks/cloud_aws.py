@@ -830,3 +830,532 @@ def lambda_tracing(st: AWSState):
                 f.tracing_mode if f.tracing_mode.explicit else f.anchor(),
                 f.address,
             )
+
+
+# -- round-4 service breadth --------------------------------------------------
+
+_SERVICE_TARGETS.update({
+    "api-gateway": "api_gateway_stages",
+    "athena": "athena_workgroups",
+    "codebuild": "codebuild_projects",
+    "documentdb": "docdb_clusters",
+    "ecs": "ecs_task_definitions",
+    "elastic-search": "elasticsearch_domains",
+    "kinesis": "kinesis_streams",
+    "mq": "mq_brokers",
+    "msk": "msk_clusters",
+    "neptune": "neptune_clusters",
+    "workspaces": "aws_workspaces",
+})
+
+
+@_check("AVD-AWS-0001", "API Gateway stages should have access logging enabled",
+        "MEDIUM", "api-gateway")
+def apigw_access_logging(st: AWSState):
+    for s in st.api_gateway_stages:
+        if not s.access_logging.bool():
+            yield CloudFailure(
+                "API Gateway stage does not enable access logging",
+                s.access_logging if s.access_logging.explicit else s.anchor(),
+                s.address,
+            )
+
+
+@_check("AVD-AWS-0003", "API Gateway stages should enable X-Ray tracing",
+        "LOW", "api-gateway")
+def apigw_xray(st: AWSState):
+    for s in st.api_gateway_stages:
+        if s.resource.labels and s.resource.labels[0] == "aws_api_gateway_stage":
+            if not s.xray_tracing.bool():
+                yield CloudFailure(
+                    "API Gateway stage does not enable X-Ray tracing",
+                    s.xray_tracing if s.xray_tracing.explicit else s.anchor(),
+                    s.address,
+                )
+
+
+@_check("AVD-AWS-0006", "Athena workgroups should encrypt query results",
+        "HIGH", "athena")
+def athena_encryption(st: AWSState):
+    for wg in st.athena_workgroups:
+        if not wg.encryption_enabled.bool():
+            yield CloudFailure(
+                "Athena workgroup does not encrypt query results",
+                wg.encryption_enabled if wg.encryption_enabled.explicit else wg.anchor(),
+                wg.address,
+            )
+
+
+@_check("AVD-AWS-0007", "Athena workgroups should enforce their configuration",
+        "MEDIUM", "athena")
+def athena_enforce(st: AWSState):
+    for wg in st.athena_workgroups:
+        if not wg.enforce_configuration.bool():
+            yield CloudFailure(
+                "Athena workgroup does not enforce its configuration",
+                wg.enforce_configuration, wg.address,
+            )
+
+
+@_check("AVD-AWS-0018", "CodeBuild projects should encrypt artifacts",
+        "HIGH", "codebuild")
+def codebuild_encryption(st: AWSState):
+    for p in st.codebuild_projects:
+        for v in p.artifact_encryption_disabled:
+            yield CloudFailure(
+                "CodeBuild project disables artifact encryption", v, p.address
+            )
+
+
+@_check("AVD-AWS-0021", "DocumentDB clusters should encrypt storage",
+        "HIGH", "documentdb")
+def docdb_storage_encrypted(st: AWSState):
+    for c in st.docdb_clusters:
+        if not c.storage_encrypted.bool():
+            yield CloudFailure(
+                "DocumentDB cluster does not encrypt storage",
+                c.storage_encrypted if c.storage_encrypted.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0020", "DocumentDB clusters should export audit logs",
+        "MEDIUM", "documentdb")
+def docdb_log_exports(st: AWSState):
+    for c in st.docdb_clusters:
+        kinds = {str(v.value) for v in c.log_exports}
+        if "audit" not in kinds:
+            yield CloudFailure(
+                "DocumentDB cluster does not export audit logs",
+                c.log_exports[0] if c.log_exports else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0022", "DocumentDB clusters should encrypt with a customer KMS key",
+        "LOW", "documentdb")
+def docdb_kms(st: AWSState):
+    for c in st.docdb_clusters:
+        if not c.kms_key_id.str():
+            yield CloudFailure(
+                "DocumentDB cluster does not use a customer-managed KMS key",
+                c.kms_key_id if c.kms_key_id.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0034", "ECS task definitions should not embed plaintext secrets",
+        "CRITICAL", "ecs")
+def ecs_no_plaintext_secrets(st: AWSState):
+    import re
+
+    pat = re.compile(
+        r"(?i)(password|secret|token|api_?key|access_?key)", re.ASCII
+    )
+    for td in st.ecs_task_definitions:
+        defs = td.container_definitions.value
+        if not isinstance(defs, list):
+            continue
+        for cd in defs:
+            if not isinstance(cd, dict):
+                continue
+            for env in cd.get("environment", []) or []:
+                if not isinstance(env, dict):
+                    continue
+                name = str(env.get("name", ""))
+                value = str(env.get("value", ""))
+                if value and pat.search(name):
+                    yield CloudFailure(
+                        f"Task definition embeds a plaintext secret in env var {name!r}",
+                        td.container_definitions, td.address,
+                    )
+
+
+@_check("AVD-AWS-0035", "ECS clusters should enable container insights",
+        "LOW", "ecs", targets="ecs_clusters")
+def ecs_container_insights(st: AWSState):
+    for c in st.ecs_clusters:
+        if not c.container_insights.bool():
+            yield CloudFailure(
+                "ECS cluster does not enable container insights",
+                c.container_insights if c.container_insights.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0048", "Elasticsearch domains should encrypt data at rest",
+        "HIGH", "elastic-search")
+def es_encrypt_at_rest(st: AWSState):
+    for d in st.elasticsearch_domains:
+        if not d.encrypt_at_rest.bool():
+            yield CloudFailure(
+                "Elasticsearch domain does not encrypt data at rest",
+                d.encrypt_at_rest if d.encrypt_at_rest.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0043", "Elasticsearch domains should encrypt node-to-node traffic",
+        "HIGH", "elastic-search")
+def es_node_to_node(st: AWSState):
+    for d in st.elasticsearch_domains:
+        if not d.node_to_node_encryption.bool():
+            yield CloudFailure(
+                "Elasticsearch domain does not encrypt node-to-node traffic",
+                d.node_to_node_encryption
+                if d.node_to_node_encryption.explicit
+                else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0046", "Elasticsearch domains should enforce HTTPS",
+        "HIGH", "elastic-search")
+def es_enforce_https(st: AWSState):
+    for d in st.elasticsearch_domains:
+        if not d.enforce_https.bool():
+            yield CloudFailure(
+                "Elasticsearch domain does not enforce HTTPS",
+                d.enforce_https if d.enforce_https.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0042", "Elasticsearch domains should use a modern TLS policy",
+        "HIGH", "elastic-search")
+def es_tls_policy(st: AWSState):
+    for d in st.elasticsearch_domains:
+        if d.tls_policy.str() == "Policy-Min-TLS-1-0-2019-07":
+            yield CloudFailure(
+                "Elasticsearch domain allows TLS 1.0",
+                d.tls_policy if d.tls_policy.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0049", "Elasticsearch domains should enable audit logging",
+        "MEDIUM", "elastic-search")
+def es_audit_logging(st: AWSState):
+    for d in st.elasticsearch_domains:
+        if not d.audit_logging.bool():
+            yield CloudFailure(
+                "Elasticsearch domain does not enable audit logging",
+                d.audit_logging if d.audit_logging.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0064", "Kinesis streams should be encrypted with KMS",
+        "HIGH", "kinesis")
+def kinesis_encryption(st: AWSState):
+    for k in st.kinesis_streams:
+        if k.encryption_type.str().upper() != "KMS":
+            yield CloudFailure(
+                "Kinesis stream is not encrypted with KMS",
+                k.encryption_type if k.encryption_type.explicit else k.anchor(),
+                k.address,
+            )
+
+
+@_check("AVD-AWS-0072", "MQ brokers should not be publicly accessible",
+        "HIGH", "mq")
+def mq_no_public(st: AWSState):
+    for b in st.mq_brokers:
+        if b.publicly_accessible.bool():
+            yield CloudFailure(
+                "MQ broker is publicly accessible", b.publicly_accessible, b.address
+            )
+
+
+@_check("AVD-AWS-0070", "MQ brokers should enable general logging",
+        "LOW", "mq")
+def mq_general_logging(st: AWSState):
+    for b in st.mq_brokers:
+        if not b.general_logging.bool():
+            yield CloudFailure(
+                "MQ broker does not enable general logging",
+                b.general_logging if b.general_logging.explicit else b.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0071", "MQ brokers should enable audit logging",
+        "MEDIUM", "mq")
+def mq_audit_logging(st: AWSState):
+    for b in st.mq_brokers:
+        if not b.audit_logging.bool():
+            yield CloudFailure(
+                "MQ broker does not enable audit logging",
+                b.audit_logging if b.audit_logging.explicit else b.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0073", "MSK clusters should encrypt client-broker traffic",
+        "HIGH", "msk")
+def msk_encryption_in_transit(st: AWSState):
+    for c in st.msk_clusters:
+        if c.client_broker_encryption.str().upper() not in ("TLS",):
+            yield CloudFailure(
+                "MSK cluster allows plaintext client-broker traffic",
+                c.client_broker_encryption
+                if c.client_broker_encryption.explicit
+                else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0074", "MSK clusters should enable broker logging",
+        "MEDIUM", "msk")
+def msk_logging(st: AWSState):
+    for c in st.msk_clusters:
+        if not c.logging_enabled.bool():
+            yield CloudFailure(
+                "MSK cluster does not enable broker logging",
+                c.logging_enabled if c.logging_enabled.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0076", "Neptune clusters should encrypt storage",
+        "HIGH", "neptune")
+def neptune_storage_encrypted(st: AWSState):
+    for n in st.neptune_clusters:
+        if not n.storage_encrypted.bool():
+            yield CloudFailure(
+                "Neptune cluster does not encrypt storage",
+                n.storage_encrypted if n.storage_encrypted.explicit else n.anchor(),
+                n.address,
+            )
+
+
+@_check("AVD-AWS-0075", "Neptune clusters should export audit logs",
+        "MEDIUM", "neptune")
+def neptune_log_exports(st: AWSState):
+    for n in st.neptune_clusters:
+        kinds = {str(v.value) for v in n.log_exports}
+        if "audit" not in kinds:
+            yield CloudFailure(
+                "Neptune cluster does not export audit logs",
+                n.log_exports[0] if n.log_exports else n.anchor(),
+                n.address,
+            )
+
+
+@_check("AVD-AWS-0128", "Neptune clusters should encrypt with a customer KMS key",
+        "LOW", "neptune")
+def neptune_kms(st: AWSState):
+    for n in st.neptune_clusters:
+        if not n.kms_key_id.str():
+            yield CloudFailure(
+                "Neptune cluster does not use a customer-managed KMS key",
+                n.kms_key_id if n.kms_key_id.explicit else n.anchor(),
+                n.address,
+            )
+
+
+@_check("AVD-AWS-0109", "WorkSpaces root volumes should be encrypted",
+        "HIGH", "workspaces")
+def workspaces_root_volume(st: AWSState):
+    for w in st.aws_workspaces:
+        if not w.root_volume_encrypted.bool():
+            yield CloudFailure(
+                "WorkSpace root volume is not encrypted",
+                w.root_volume_encrypted
+                if w.root_volume_encrypted.explicit
+                else w.anchor(),
+                w.address,
+            )
+
+
+@_check("AVD-AWS-0112", "WorkSpaces user volumes should be encrypted",
+        "HIGH", "workspaces")
+def workspaces_user_volume(st: AWSState):
+    for w in st.aws_workspaces:
+        if not w.user_volume_encrypted.bool():
+            yield CloudFailure(
+                "WorkSpace user volume is not encrypted",
+                w.user_volume_encrypted
+                if w.user_volume_encrypted.explicit
+                else w.anchor(),
+                w.address,
+            )
+
+
+@_check("AVD-AWS-0129", "Launch templates should require IMDSv2 tokens",
+        "HIGH", "ec2", targets="launch_templates")
+def launch_template_imdsv2(st: AWSState):
+    for lt in st.launch_templates:
+        if lt.http_tokens.str() != "required":
+            yield CloudFailure(
+                "Launch template does not require IMDSv2 session tokens",
+                lt.http_tokens if lt.http_tokens.explicit else lt.anchor(),
+                lt.address,
+            )
+
+
+_SERVICE_TARGETS.update({
+    "cloudwatch": "log_groups",
+    "secretsmanager": "secretsmanager_secrets",
+    "dax": "dax_clusters",
+})
+
+
+@_check("AVD-AWS-0017", "CloudWatch log groups should be encrypted with customer KMS keys",
+        "LOW", "cloudwatch")
+def log_group_cmk(st: AWSState):
+    for lg in st.log_groups:
+        if not lg.kms_key_id.str():
+            yield CloudFailure(
+                "Log group is not encrypted with a customer-managed KMS key",
+                lg.kms_key_id if lg.kms_key_id.explicit else lg.anchor(),
+                lg.address,
+            )
+
+
+@_check("AVD-AWS-0005", "API Gateway domains should use a modern TLS policy",
+        "HIGH", "api-gateway", targets="api_gateway_domains")
+def apigw_domain_tls(st: AWSState):
+    for d in st.api_gateway_domains:
+        if d.security_policy.str() != "TLS_1_2":
+            yield CloudFailure(
+                "API Gateway domain allows TLS versions older than 1.2",
+                d.security_policy if d.security_policy.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0079", "RDS clusters should encrypt storage", "HIGH", "rds",
+        targets="rds_clusters")
+def rds_cluster_encrypted(st: AWSState):
+    for c in st.rds_clusters:
+        if not c.storage_encrypted.bool():
+            yield CloudFailure(
+                "RDS cluster does not encrypt storage",
+                c.storage_encrypted if c.storage_encrypted.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0078", "RDS clusters should retain backups beyond one day",
+        "MEDIUM", "rds", targets="rds_clusters")
+def rds_cluster_backup(st: AWSState):
+    for c in st.rds_clusters:
+        if c.backup_retention.int(1) <= 1:
+            yield CloudFailure(
+                "RDS cluster keeps the default 1-day backup retention",
+                c.backup_retention if c.backup_retention.explicit else c.anchor(),
+                c.address,
+            )
+
+
+@_check("AVD-AWS-0098", "Secrets Manager secrets should use customer KMS keys",
+        "LOW", "secretsmanager")
+def secretsmanager_cmk(st: AWSState):
+    for s in st.secretsmanager_secrets:
+        if not s.kms_key_id.str():
+            yield CloudFailure(
+                "Secret is not encrypted with a customer-managed KMS key",
+                s.kms_key_id if s.kms_key_id.explicit else s.anchor(),
+                s.address,
+            )
+
+
+@_check("AVD-AWS-0023", "DAX clusters should enable server-side encryption",
+        "HIGH", "dax")
+def dax_sse(st: AWSState):
+    for d in st.dax_clusters:
+        if not d.sse_enabled.bool():
+            yield CloudFailure(
+                "DAX cluster does not enable server-side encryption",
+                d.sse_enabled if d.sse_enabled.explicit else d.anchor(),
+                d.address,
+            )
+
+
+@_check("AVD-AWS-0134", "EBS default encryption should be enabled", "HIGH",
+        "ec2", targets="ebs_default_encryption")
+def ebs_default_encryption(st: AWSState):
+    for e in st.ebs_default_encryption:
+        if not e.enabled.bool(True):
+            yield CloudFailure(
+                "EBS encryption-by-default is explicitly disabled",
+                e.enabled, e.address,
+            )
+
+
+@_check("AVD-AWS-0132", "S3 buckets should be encrypted with customer KMS keys",
+        "LOW", "s3")
+def s3_cmk(st: AWSState):
+    for b in st.s3_buckets:
+        if b.encryption_enabled.bool() and not b.kms_key_id.str():
+            yield CloudFailure(
+                "Bucket encryption does not use a customer-managed KMS key",
+                b.kms_key_id if b.kms_key_id.explicit else b.anchor(),
+                b.address,
+            )
+
+
+@_check("AVD-AWS-0099", "Security groups should have descriptions", "LOW",
+        "ec2", targets="security_groups")
+def sg_description(st: AWSState):
+    for sg in st.security_groups:
+        if not sg.description.str():
+            yield CloudFailure(
+                "Security group has no description",
+                sg.description if sg.description.explicit else sg.anchor(),
+                sg.address,
+            )
+
+
+@_check("AVD-AWS-0135", "ECS containers should not run privileged", "HIGH",
+        "ecs")
+def ecs_no_privileged(st: AWSState):
+    for td in st.ecs_task_definitions:
+        defs = td.container_definitions.value
+        if not isinstance(defs, list):
+            continue
+        for cd in defs:
+            if isinstance(cd, dict) and cd.get("privileged") is True:
+                yield CloudFailure(
+                    f"Container {cd.get('name', '?')!r} runs privileged",
+                    td.container_definitions, td.address,
+                )
+
+
+@_check("AVD-AWS-0176", "RDS instances should enable IAM database authentication",
+        "MEDIUM", "rds")
+def rds_iam_auth(st: AWSState):
+    for db in st.rds_instances:
+        if not db.iam_auth.bool():
+            yield CloudFailure(
+                "RDS instance does not enable IAM database authentication",
+                db.iam_auth if db.iam_auth.explicit else db.anchor(),
+                db.address,
+            )
+
+
+@_check("AVD-AWS-0177", "RDS instances should enable deletion protection",
+        "MEDIUM", "rds")
+def rds_deletion_protection(st: AWSState):
+    for db in st.rds_instances:
+        if not db.deletion_protection.bool():
+            yield CloudFailure(
+                "RDS instance does not enable deletion protection",
+                db.deletion_protection
+                if db.deletion_protection.explicit
+                else db.anchor(),
+                db.address,
+            )
+
+
+@_check("AVD-AWS-0178", "CloudWatch log groups should define a retention period",
+        "LOW", "cloudwatch")
+def log_group_retention(st: AWSState):
+    for lg in st.log_groups:
+        if lg.retention_days.int() == 0:
+            yield CloudFailure(
+                "Log group retains logs forever (no retention period)",
+                lg.retention_days if lg.retention_days.explicit else lg.anchor(),
+                lg.address,
+            )

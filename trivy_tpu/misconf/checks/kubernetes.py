@@ -320,3 +320,450 @@ def restricted_capabilities(w: Workload):
                 message=f"Container '{c.name}' of {w.kind} '{w.name}' adds disallowed capabilities {bad}",
                 start_line=s, end_line=e, resource=_cname(w, c),
             )
+
+
+# -- round-4 additions: pod hardening, volumes, namespaces, RBAC --------------
+
+@_check("KSV002", "AVD-KSV-0002", "Default AppArmor profile not set", "MEDIUM",
+        "Containers should run under an AppArmor profile.",
+        "Annotate container.apparmor.security.beta.kubernetes.io/<name>.")
+def apparmor_profile(w: Workload):
+    meta = w.raw.get("metadata")
+    annotations = (
+        meta.get("annotations") if isinstance(meta, dict) else None
+    )
+    annotations = annotations if isinstance(annotations, dict) else {}
+    # pod templates carry annotations in spec.template.metadata
+    tmpl = w.raw.get("spec")
+    if isinstance(tmpl, dict):
+        t = tmpl.get("template")
+        if isinstance(t, dict):
+            tm = t.get("metadata")
+            if isinstance(tm, dict) and isinstance(tm.get("annotations"), dict):
+                annotations = {**annotations, **tm.get("annotations")}
+    for c in w.containers:
+        if c.kind != "container":
+            continue
+        key = f"container.apparmor.security.beta.kubernetes.io/{c.name}"
+        if key not in annotations:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should specify an AppArmor profile",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV005", "AVD-KSV-0005", "SYS_ADMIN capability added", "HIGH",
+        "CAP_SYS_ADMIN is the most privileged capability.",
+        "Remove SYS_ADMIN from securityContext.capabilities.add.")
+def sys_admin_capability(w: Workload):
+    for c in w.containers:
+        caps = c.security_context().get("capabilities")
+        add = caps.get("add", []) if isinstance(caps, dict) else []
+        if any(str(a).upper() == "SYS_ADMIN" for a in (add or [])):
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should not add SYS_ADMIN capability",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+@_check("KSV006", "AVD-KSV-0006", "hostPath volume mounts docker.sock", "HIGH",
+        "Mounting the docker socket grants control of the container runtime.",
+        "Remove the /var/run/docker.sock hostPath volume.")
+def docker_sock_mount(w: Workload):
+    vols = w.pod_spec.get("volumes")
+    for v in (vols or []) if isinstance(vols, (list, tuple)) or hasattr(vols, "__iter__") else []:
+        if not isinstance(v, dict):
+            continue
+        hp = v.get("hostPath")
+        if isinstance(hp, dict) and str(hp.get("path", "")) == "/var/run/docker.sock":
+            s, e = span_of(v)
+            yield Failure(
+                message=f"{w.kind} '{w.name}' should not mount /var/run/docker.sock",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_check("KSV007", "AVD-KSV-0007", "hostAliases is set", "MEDIUM",
+        "hostAliases undermines DNS-based controls.", "Remove hostAliases.")
+def host_aliases(w: Workload):
+    if w.pod_spec.get("hostAliases") is not None:
+        line = w.pod_spec.line("hostAliases")
+        yield Failure(
+            message=f"{w.kind} '{w.name}' should not set 'spec.hostAliases'",
+            start_line=line, end_line=line, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV022", "AVD-KSV-0022", "Non-default capabilities added", "MEDIUM",
+        "Adding capabilities beyond the default set expands the attack surface.",
+        "Remove entries from securityContext.capabilities.add.")
+def added_capabilities(w: Workload):
+    for c in w.containers:
+        caps = c.security_context().get("capabilities")
+        add = caps.get("add", []) if isinstance(caps, dict) else []
+        for a in add or []:
+            if str(a).upper() not in ("NET_BIND_SERVICE",):
+                s, e = _cspan(c)
+                yield Failure(
+                    message=f"Container '{c.name}' of {w.kind} '{w.name}' should not add capability '{a}'",
+                    start_line=s, end_line=e, resource=_cname(w, c),
+                )
+                break
+
+
+@_check("KSV024", "AVD-KSV-0024", "hostPort is set", "HIGH",
+        "hostPort binds the container to the node's network.",
+        "Remove ports[].hostPort.")
+def host_port(w: Workload):
+    for c in w.containers:
+        ports = c.raw.get("ports")
+        for p in ports or []:
+            if isinstance(p, dict) and p.get("hostPort") is not None:
+                s, e = span_of(p)
+                yield Failure(
+                    message=f"Container '{c.name}' of {w.kind} '{w.name}' should not set hostPort",
+                    start_line=s, end_line=e, resource=_cname(w, c),
+                )
+
+
+@_check("KSV025", "AVD-KSV-0025", "Custom SELinux options set", "MEDIUM",
+        "Custom SELinux user/role options weaken isolation.",
+        "Remove seLinuxOptions, or use only allowed type values.")
+def selinux_options(w: Workload):
+    scopes = [w.pod_security_context()] + [c.security_context() for c in w.containers]
+    for sc in scopes:
+        sel = sc.get("seLinuxOptions")
+        if isinstance(sel, dict) and (sel.get("user") or sel.get("role")):
+            s, e = span_of(sel) if hasattr(sel, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' sets custom SELinux user/role options",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+_UNSAFE_SYSCTLS_ALLOWED = {
+    "kernel.shm_rmid_forced", "net.ipv4.ip_local_port_range",
+    "net.ipv4.ip_unprivileged_port_start", "net.ipv4.tcp_syncookies",
+    "net.ipv4.ping_group_range",
+}
+
+
+@_check("KSV026", "AVD-KSV-0026", "Unsafe sysctl options set", "MEDIUM",
+        "Only a small allowlist of sysctls is considered safe.",
+        "Remove sysctls outside the safe set.")
+def unsafe_sysctls(w: Workload):
+    sysctls = w.pod_security_context().get("sysctls")
+    for sc in sysctls or []:
+        if isinstance(sc, dict) and str(sc.get("name", "")) not in _UNSAFE_SYSCTLS_ALLOWED:
+            s, e = span_of(sc) if hasattr(sc, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' sets unsafe sysctl '{sc.get('name')}'",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_check("KSV027", "AVD-KSV-0027", "Non-default /proc mount", "MEDIUM",
+        "An Unmasked procMount exposes host kernel interfaces.",
+        "Remove securityContext.procMount.")
+def proc_mount(w: Workload):
+    for c in w.containers:
+        pm = c.security_context().get("procMount")
+        if pm is not None and str(pm) != "Default":
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' should not set a non-default procMount",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+
+
+_RESTRICTED_VOLUME_TYPES = (
+    "gcePersistentDisk", "awsElasticBlockStore", "gitRepo", "nfs", "iscsi",
+    "glusterfs", "rbd", "flexVolume", "cinder", "cephfs", "flocker", "fc",
+    "azureFile", "vsphereVolume", "quobyte", "azureDisk", "portworxVolume",
+    "scaleIO", "storageos", "hostPath",
+)
+
+
+@_check("KSV028", "AVD-KSV-0028", "Non-ephemeral volume types used", "LOW",
+        "Restricted pod security only permits ephemeral/approved volume types.",
+        "Use configMap/secret/emptyDir/ephemeral/persistentVolumeClaim volumes.")
+def restricted_volume_types(w: Workload):
+    vols = w.pod_spec.get("volumes")
+    for v in vols or []:
+        if not isinstance(v, dict):
+            continue
+        for vt in _RESTRICTED_VOLUME_TYPES:
+            if vt in v:
+                s, e = span_of(v)
+                yield Failure(
+                    message=f"{w.kind} '{w.name}' uses restricted volume type '{vt}'",
+                    start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+                )
+                break
+
+
+@_check("KSV029", "AVD-KSV-0029", "Root group or supplemental groups set", "LOW",
+        "A GID of 0 grants root-group file access.",
+        "Set runAsGroup/fsGroup/supplementalGroups to non-zero values.")
+def root_group(w: Workload):
+    psc = w.pod_security_context()
+    offenders = []
+    if psc.get("runAsGroup") == 0:
+        offenders.append("runAsGroup")
+    if psc.get("fsGroup") == 0:
+        offenders.append("fsGroup")
+    if any(g == 0 for g in (psc.get("supplementalGroups") or [])):
+        offenders.append("supplementalGroups")
+    for c in w.containers:
+        if c.security_context().get("runAsGroup") == 0:
+            s, e = _cspan(c)
+            yield Failure(
+                message=f"Container '{c.name}' of {w.kind} '{w.name}' runs with GID 0",
+                start_line=s, end_line=e, resource=_cname(w, c),
+            )
+    if offenders:
+        s, e = w.span
+        yield Failure(
+            message=f"{w.kind} '{w.name}' sets root group via {', '.join(offenders)}",
+            start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV036", "AVD-KSV-0036", "Service account token auto-mounted", "MEDIUM",
+        "Pods that do not call the API server should not mount a token.",
+        "Set automountServiceAccountToken to false.")
+def automount_sa_token(w: Workload):
+    if w.pod_spec.get("automountServiceAccountToken") is not False:
+        s, e = w.span
+        yield Failure(
+            message=f"{w.kind} '{w.name}' should set 'automountServiceAccountToken' to false",
+            start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV037", "AVD-KSV-0037", "Workload deployed into the system namespace", "MEDIUM",
+        "User workloads in kube-system can tamper with cluster components.",
+        "Deploy into a dedicated namespace.")
+def system_namespace(w: Workload):
+    meta = w.raw.get("metadata")
+    ns = str(meta.get("namespace", "")) if isinstance(meta, dict) else ""
+    if ns == "kube-system":
+        s, e = w.span
+        yield Failure(
+            message=f"{w.kind} '{w.name}' should not be deployed into 'kube-system'",
+            start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+        )
+
+
+# -- RBAC (Role/ClusterRole kinds, outside the pod-spec wrapper) -------------
+
+def _rbac_check(id_, avd, title, severity, desc="", res=""):
+    def wrap(fn):
+        def run(workloads):
+            for w in workloads:
+                if w.kind in ("Role", "ClusterRole"):
+                    yield from fn(w)
+
+        register(
+            Check(
+                id=id_, avd_id=avd, title=title, severity=severity,
+                file_types=_K8S, fn=run, description=desc, resolution=res,
+                url=_URL.format(id_.lower()), service="rbac",
+                provider="kubernetes",
+            )
+        )
+        return fn
+
+    return wrap
+
+
+def _rules(w: Workload):
+    rules = w.raw.get("rules")
+    for r in rules or []:
+        if isinstance(r, dict):
+            yield r
+
+
+@_rbac_check("KSV041", "AVD-KSV-0041", "Role permits management of secrets", "CRITICAL",
+             "Managing secrets grants access to every credential in the namespace.",
+             "Scope secret access to named resources, or drop write verbs.")
+def rbac_manage_secrets(w: Workload):
+    for r in _rules(w):
+        resources = [str(x) for x in (r.get("resources") or [])]
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if "secrets" in resources and any(
+            v in ("create", "update", "patch", "delete", "deletecollection", "*")
+            for v in verbs
+        ):
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits managing secrets",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV044", "AVD-KSV-0044", "Role permits wildcard verb on wildcard resource",
+             "CRITICAL",
+             "A '*' verb on '*' resources is full cluster control.",
+             "Enumerate the specific verbs and resources required.")
+def rbac_wildcard(w: Workload):
+    for r in _rules(w):
+        resources = [str(x) for x in (r.get("resources") or [])]
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if "*" in resources and "*" in verbs:
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits all verbs on all resources",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV042", "AVD-KSV-0042", "Role permits deleting pod logs", "MEDIUM",
+             "Deleting pod logs lets an attacker cover their tracks.",
+             "Remove delete verbs on pods/log.")
+def rbac_delete_pod_logs(w: Workload):
+    for r in _rules(w):
+        resources = [str(x) for x in (r.get("resources") or [])]
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if "pods/log" in resources and any(
+            v in ("delete", "deletecollection", "*") for v in verbs
+        ):
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits deleting pod logs",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV045", "AVD-KSV-0045", "Role permits wildcard verbs", "CRITICAL",
+             "A '*' verb grants every present and future verb on the resource.",
+             "Enumerate the specific verbs required.")
+def rbac_wildcard_verbs(w: Workload):
+    for r in _rules(w):
+        resources = [str(x) for x in (r.get("resources") or [])]
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if "*" in verbs and "*" not in resources:
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits wildcard verbs on specific resources",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV047", "AVD-KSV-0047", "Role permits privilege escalation verbs",
+             "CRITICAL",
+             "escalate/bind/impersonate allow privilege escalation past RBAC.",
+             "Remove escalate, bind and impersonate verbs.")
+def rbac_escalation_verbs(w: Workload):
+    for r in _rules(w):
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        bad = [v for v in verbs if v in ("escalate", "bind", "impersonate")]
+        if bad:
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits privilege escalation verb(s) {', '.join(bad)}",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV053", "AVD-KSV-0053", "Role permits getting a shell on pods", "HIGH",
+             "pods/exec create grants interactive access to every pod.",
+             "Remove create on pods/exec.")
+def rbac_pod_exec(w: Workload):
+    for r in _rules(w):
+        resources = [str(x) for x in (r.get("resources") or [])]
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if "pods/exec" in resources and any(v in ("create", "*") for v in verbs):
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits exec into pods",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV054", "AVD-KSV-0054", "Role permits attaching to pods", "HIGH",
+             "pods/attach create grants access to running container streams.",
+             "Remove create on pods/attach.")
+def rbac_pod_attach(w: Workload):
+    for r in _rules(w):
+        resources = [str(x) for x in (r.get("resources") or [])]
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if "pods/attach" in resources and any(v in ("create", "*") for v in verbs):
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits attaching to pods",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+@_rbac_check("KSV056", "AVD-KSV-0056", "Role permits managing networking resources",
+             "HIGH",
+             "Control of services/networkpolicies/ingresses can reroute traffic.",
+             "Scope networking write access narrowly.")
+def rbac_manage_networking(w: Workload):
+    net = {"services", "endpoints", "endpointslices", "networkpolicies", "ingresses"}
+    for r in _rules(w):
+        resources = {str(x) for x in (r.get("resources") or [])}
+        verbs = [str(x) for x in (r.get("verbs") or [])]
+        if resources & net and any(
+            v in ("create", "update", "patch", "delete", "*") for v in verbs
+        ):
+            s, e = span_of(r) if hasattr(r, "keys") else w.span
+            yield Failure(
+                message=f"{w.kind} '{w.name}' permits managing networking resources",
+                start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+            )
+
+
+# role bindings get their own kind wrapper
+def _binding_check(id_, avd, title, severity, desc="", res=""):
+    def wrap(fn):
+        def run(workloads):
+            for w in workloads:
+                if w.kind in ("RoleBinding", "ClusterRoleBinding"):
+                    yield from fn(w)
+
+        register(
+            Check(
+                id=id_, avd_id=avd, title=title, severity=severity,
+                file_types=_K8S, fn=run, description=desc, resolution=res,
+                url=_URL.format(id_.lower()), service="rbac",
+                provider="kubernetes",
+            )
+        )
+        return fn
+
+    return wrap
+
+
+@_binding_check("KSV043", "AVD-KSV-0043", "Binding to the cluster-admin role",
+                "CRITICAL",
+                "cluster-admin grants unrestricted cluster control.",
+                "Bind to a narrowly-scoped role instead.")
+def rbac_cluster_admin_binding(w: Workload):
+    ref = w.raw.get("roleRef")
+    if isinstance(ref, dict) and str(ref.get("name")) == "cluster-admin":
+        s, e = span_of(ref) if hasattr(ref, "keys") else w.span
+        yield Failure(
+            message=f"{w.kind} '{w.name}' binds to the cluster-admin role",
+            start_line=s, end_line=e, resource=f"{w.kind} {w.name}",
+        )
+
+
+@_check("KSV117", "AVD-KSV-0117", "Container binds a privileged port", "MEDIUM",
+        "Ports below 1024 require elevated capabilities.",
+        "Use an unprivileged containerPort (>= 1024).")
+def privileged_ports(w: Workload):
+    for c in w.containers:
+        ports = c.raw.get("ports")
+        for p in ports or []:
+            if isinstance(p, dict):
+                cp = p.get("containerPort")
+                if isinstance(cp, int) and 0 < cp < 1024:
+                    s, e = span_of(p)
+                    yield Failure(
+                        message=f"Container '{c.name}' of {w.kind} '{w.name}' binds privileged port {cp}",
+                        start_line=s, end_line=e, resource=_cname(w, c),
+                    )

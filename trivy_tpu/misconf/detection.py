@@ -18,6 +18,7 @@ FILE_TYPE_YAML = "yaml"
 FILE_TYPE_JSON = "json"
 FILE_TYPE_HELM = "helm"
 FILE_TYPE_AZURE_ARM = "azure-arm"
+FILE_TYPE_TERRAFORM_PLAN = "terraformplan-json"
 
 # types with builtin check sets — detection order matters: most specific
 # first (a k8s manifest is also valid yaml; a CFN template is also json)
@@ -163,12 +164,29 @@ def is_yaml(path: str, content: bytes) -> bool:
     return _load_yaml_docs(content) is not None
 
 
+def is_terraform_plan(path: str, content: bytes) -> bool:
+    """tfplan JSON (`terraform show -json plan`): format_version +
+    planned_values markers (ref: pkg/iac/detection detect for
+    terraformplan-json)."""
+    if not path.endswith(".json"):
+        return False
+    if b"planned_values" not in content or b"format_version" not in content:
+        return False
+    try:
+        doc = json.loads(content)
+    except Exception:
+        return False
+    return isinstance(doc, dict) and "planned_values" in doc
+
+
 def detect_type(path: str, content: bytes) -> str | None:
     """Most-specific IaC file type for routing, or None."""
     if is_dockerfile(path):
         return FILE_TYPE_DOCKERFILE
     if is_terraform(path):
         return FILE_TYPE_TERRAFORM
+    if is_terraform_plan(path, content):
+        return FILE_TYPE_TERRAFORM_PLAN
     if is_cloudformation(path, content):
         return FILE_TYPE_CLOUDFORMATION
     if is_azure_arm(path, content):

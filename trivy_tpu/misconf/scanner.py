@@ -37,6 +37,7 @@ _SCANNER_NAMES = {
     detection.FILE_TYPE_CLOUDFORMATION: "CloudFormation",
     detection.FILE_TYPE_HELM: "Helm",
     detection.FILE_TYPE_AZURE_ARM: "Azure ARM",
+    detection.FILE_TYPE_TERRAFORM_PLAN: "Terraform Plan",
 }
 
 
@@ -116,6 +117,8 @@ class MisconfScanner:
             return None
         if ftype == detection.FILE_TYPE_CLOUDFORMATION:
             return self._scan_cloudformation(path, content)
+        if ftype == detection.FILE_TYPE_TERRAFORM_PLAN:
+            return self._scan_tfplan(path, content)
         if ftype == detection.FILE_TYPE_AZURE_ARM:
             return self._scan_arm(path, content)
         try:
@@ -137,25 +140,64 @@ class MisconfScanner:
 
     def _scan_terraform(self, tf_files: dict[str, bytes]) -> list[Misconfiguration]:
         from trivy_tpu.misconf import terraform
-        from trivy_tpu.misconf.adapters import aws_tf
 
         try:
             texts = {
                 p: c.decode("utf-8", "replace") for p, c in tf_files.items()
             }
             resources = terraform.load(texts)
-            state = aws_tf.adapt(resources)
         except Exception as e:
             logger.warning("terraform evaluation failed: %s", e)
             return []
-        by_file = evaluate_cloud(
-            state,
-            sorted(tf_files),
-            detection.FILE_TYPE_TERRAFORM,
-            _SCANNER_NAMES[detection.FILE_TYPE_TERRAFORM],
-            enabled=self._enabled,
+        return self._evaluate_tf_resources(
+            resources, sorted(tf_files), detection.FILE_TYPE_TERRAFORM
         )
-        return list(by_file.values())
+
+    def _evaluate_tf_resources(
+        self, resources, files: list[str], ftype: str
+    ) -> list[Misconfiguration]:
+        """Adapt parsed terraform resources into every provider's typed
+        state and evaluate the provider check sets, merging per file (ref:
+        pkg/iac/adapters/terraform/* each adapting one provider)."""
+        from trivy_tpu.misconf.adapters import aws_tf, azure_tf, github_state, google_tf
+
+        merged: dict[str, Misconfiguration] = {}
+        for adapt in (aws_tf.adapt, azure_tf.adapt, google_tf.adapt, github_state.adapt):
+            try:
+                state = adapt(resources)
+            except Exception as e:
+                logger.warning("%s adapter failed: %s", adapt.__module__, e)
+                continue
+            by_file = evaluate_cloud(
+                state,
+                files,
+                ftype,
+                _SCANNER_NAMES.get(ftype, ftype),
+                enabled=self._enabled,
+            )
+            for path, mc in by_file.items():
+                if path not in merged:
+                    merged[path] = mc
+                else:
+                    merged[path].failures.extend(mc.failures)
+                    merged[path].successes.extend(mc.successes)
+        for mc in merged.values():
+            mc.successes.sort(key=lambda r: r.id)
+            mc.failures.sort(key=lambda r: (r.id, r.start_line, r.message))
+        return list(merged.values())
+
+    def _scan_tfplan(self, path: str, content: bytes) -> Misconfiguration | None:
+        from trivy_tpu.misconf import tfplan
+
+        try:
+            resources = tfplan.load(path, content)
+        except Exception as e:
+            logger.debug("tfplan parse failed for %s: %s", path, e)
+            return None
+        out = self._evaluate_tf_resources(
+            resources, [path], detection.FILE_TYPE_TERRAFORM_PLAN
+        )
+        return out[0] if out else None
 
     def _scan_cloudformation(self, path: str, content: bytes) -> Misconfiguration | None:
         from trivy_tpu.misconf import cloudformation
