@@ -1,0 +1,147 @@
+"""In-process OCI distribution registry for tests (the reference's own
+technique: its integration suite runs a local registry,
+pkg/fanal/test/integration). Serves manifests/blobs from memory over
+plain HTTP, with optional bearer-token auth exercising the challenge
+flow."""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tests.imagetest import tar_bytes
+
+
+def digest_of(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+class MemoryRegistry:
+    """repo -> {"manifests": {ref: (bytes, media_type)}, "blobs": {digest: bytes}}"""
+
+    def __init__(self, token: str = ""):
+        self.repos: dict[str, dict] = {}
+        self.token = token  # non-empty -> bearer auth required
+
+    def put_blob(self, repo: str, data: bytes) -> str:
+        d = digest_of(data)
+        self.repos.setdefault(repo, {"manifests": {}, "blobs": {}})["blobs"][d] = data
+        return d
+
+    def put_manifest(self, repo: str, ref: str, doc: dict, media_type: str) -> str:
+        data = json.dumps(doc).encode()
+        r = self.repos.setdefault(repo, {"manifests": {}, "blobs": {}})
+        r["manifests"][ref] = (data, media_type)
+        r["manifests"][digest_of(data)] = (data, media_type)
+        return digest_of(data)
+
+    def add_image(self, repo: str, tag: str, layers: list[bytes],
+                  env: list[str] | None = None) -> None:
+        """Build a gzip-layered OCI image from uncompressed layer tars."""
+        diff_ids = [digest_of(l) for l in layers]
+        gz = [gzip.compress(l) for l in layers]
+        config = {
+            "architecture": "amd64",
+            "os": "linux",
+            "config": {"Env": env or []},
+            "rootfs": {"type": "layers", "diff_ids": diff_ids},
+            "history": [
+                {"created_by": f"COPY layer{i}"} for i in range(len(layers))
+            ],
+        }
+        config_bytes = json.dumps(config).encode()
+        cfg_digest = self.put_blob(repo, config_bytes)
+        layer_descs = []
+        for g in gz:
+            d = self.put_blob(repo, g)
+            layer_descs.append({
+                "mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+                "digest": d,
+                "size": len(g),
+            })
+        manifest = {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "config": {
+                "mediaType": "application/vnd.oci.image.config.v1+json",
+                "digest": cfg_digest,
+                "size": len(config_bytes),
+            },
+            "layers": layer_descs,
+        }
+        self.put_manifest(
+            repo, tag, manifest, "application/vnd.oci.image.manifest.v1+json"
+        )
+
+
+def start_registry(registry: MemoryRegistry) -> tuple[ThreadingHTTPServer, str]:
+    """-> (server, 'localhost:<port>'). Caller must shutdown()."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # keep test output clean
+            pass
+
+        def _unauthorized(self):
+            host = f"localhost:{self.server.server_address[1]}"
+            self.send_response(401)
+            self.send_header(
+                "WWW-Authenticate",
+                f'Bearer realm="http://{host}/token",service="test-registry",'
+                f'scope="repository:*:pull"',
+            )
+            self.end_headers()
+
+        def do_GET(self):
+            if self.path.startswith("/token"):
+                body = json.dumps({"token": registry.token}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if registry.token:
+                auth = self.headers.get("Authorization", "")
+                if auth != f"Bearer {registry.token}":
+                    self._unauthorized()
+                    return
+            if self.path == "/v2/":
+                self.send_response(200)
+                self.end_headers()
+                return
+            parts = self.path.strip("/").split("/")
+            # /v2/<name...>/manifests/<ref> | /v2/<name...>/blobs/<digest>
+            if len(parts) >= 4 and parts[0] == "v2":
+                kind = parts[-2]
+                ref = parts[-1]
+                repo = "/".join(parts[1:-2])
+                r = registry.repos.get(repo)
+                if r is None:
+                    self.send_error(404)
+                    return
+                if kind == "manifests" and ref in r["manifests"]:
+                    data, mt = r["manifests"][ref]
+                    self.send_response(200)
+                    self.send_header("Content-Type", mt)
+                    self.send_header("Docker-Content-Digest", digest_of(data))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if kind == "blobs" and ref in r["blobs"]:
+                    data = r["blobs"][ref]
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/octet-stream"
+                    )
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+            self.send_error(404)
+
+    server = ThreadingHTTPServer(("localhost", 0), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, f"localhost:{server.server_address[1]}"

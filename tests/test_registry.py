@@ -1,0 +1,158 @@
+"""Registry image source: pull, auth challenge, digest verification, and
+the full scan pipeline against an in-process registry (the reference's
+local-registry integration technique, pkg/fanal/test/integration)."""
+
+import pytest
+
+from tests.imagetest import tar_bytes
+from tests.registrytest import MemoryRegistry, start_registry
+
+from trivy_tpu.artifact.image import ImageRegistryArtifact, new_image_artifact
+from trivy_tpu.artifact.local_fs import ArtifactOption
+from trivy_tpu.cache import new_cache
+from trivy_tpu.fanal.image_registry import (
+    RegistryClient,
+    RegistryError,
+    RegistryImage,
+    parse_image_ref,
+)
+
+GHP = "ghp_" + "A" * 36
+
+
+@pytest.fixture(scope="module")
+def registry():
+    reg = MemoryRegistry()
+    reg.add_image(
+        "apps/web", "v1",
+        [
+            tar_bytes({
+                "etc/alpine-release": b"3.18.4\n",
+                "lib/apk/db/installed": (
+                    b"P:musl\nV:1.2.4-r1\nA:x86_64\n\n"
+                    b"P:busybox\nV:1.36.1-r0\nA:x86_64\n\n"
+                ),
+            }),
+            tar_bytes({"app/config.py": f"token = '{GHP}'\n".encode()}),
+        ],
+        env=["API_KEY=plain"],
+    )
+    server, host = start_registry(reg)
+    yield host
+    server.shutdown()
+
+
+@pytest.fixture(scope="module")
+def auth_registry():
+    reg = MemoryRegistry(token="s3cret-token")
+    reg.add_image("private/app", "latest",
+                  [tar_bytes({"hello.txt": b"hi\n"})])
+    server, host = start_registry(reg)
+    yield host
+    server.shutdown()
+
+
+def test_parse_image_ref():
+    assert parse_image_ref("localhost:5000/app:v1") == (
+        "localhost:5000", "app", "v1"
+    )
+    assert parse_image_ref("registry.example.com/team/app") == (
+        "registry.example.com", "team/app", "latest"
+    )
+    assert parse_image_ref("alpine:3.18") == (
+        "registry-1.docker.io", "library/alpine", "3.18"
+    )
+    ref = "localhost:5000/app@sha256:" + "a" * 64
+    assert parse_image_ref(ref)[2] == "sha256:" + "a" * 64
+
+
+def test_pull_image_surface(registry):
+    img = RegistryImage(f"{registry}/apps/web:v1", insecure=True)
+    assert img.image_id.startswith("sha256:")
+    assert len(img.diff_ids) == 2
+    # layer streams decompress to walkable tars
+    import tarfile
+
+    with tarfile.open(fileobj=img.layer_stream(1)) as tf:
+        assert "app/config.py" in tf.getnames()
+    assert img.layer_history()[0]["created_by"] == "COPY layer0"
+
+
+def test_digest_verification(registry):
+    client = RegistryClient(registry, insecure=True)
+    with pytest.raises(RegistryError):
+        client.blob("apps/web", "sha256:" + "0" * 64)  # missing -> 404 error
+    manifest = client.manifest("apps/web", "v1")
+    good = manifest["layers"][0]["digest"]
+    assert client.blob("apps/web", good)  # digest verified internally
+
+
+def test_token_auth_challenge(auth_registry):
+    img = RegistryImage(f"{auth_registry}/private/app:latest", insecure=True)
+    assert len(img.diff_ids) == 1
+    # client went through the 401 -> token -> retry flow
+    assert img.client._token == "s3cret-token"
+
+
+def test_scan_pipeline_from_registry(registry, tmp_path):
+    cache = new_cache("fs", str(tmp_path / "cache"))
+    art = ImageRegistryArtifact(
+        f"{registry}/apps/web:v1", cache,
+        ArtifactOption(backend="cpu", insecure_registry=True),
+    )
+    ref = art.inspect()
+    assert len(ref.blob_ids) == 3  # 2 layers + config blob
+    from trivy_tpu.scanner import Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver, ScanOptions
+
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=["secret"])
+    )
+    findings = [s for r in report.results for s in r.secrets]
+    assert any(f.rule_id == "github-pat" for f in findings)
+    # OS packages surfaced from the apk layer
+    assert report.results  # scan completed with layered blobs
+
+
+def test_new_image_artifact_resolution(registry, tmp_path):
+    cache = new_cache("memory", None)
+    art = new_image_artifact(f"{registry}/apps/web:v1", cache,
+                             ArtifactOption(backend="cpu", insecure_registry=True))
+    assert isinstance(art, ImageRegistryArtifact)
+    missing = tmp_path / "nope.tar"
+    with pytest.raises(RegistryError):
+        # not a file, not a reachable registry
+        new_image_artifact(str(missing), cache,
+                           ArtifactOption(backend="cpu")).inspect()
+
+
+def test_k8s_workload_image_scanning(registry):
+    """The k8s vertical pulls and scans workload images through the
+    registry source (pkg/k8s image scanning analog)."""
+    from trivy_tpu import k8s
+
+    docs = [
+        {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "prod"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "app", "image": f"{registry}/apps/web:v1"},
+            ]}}},
+        },
+        {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "tool"},
+            "spec": {"containers": [
+                {"name": "t", "image": "unreachable.invalid/x:1"},
+            ]},
+        },
+    ]
+    images = k8s.workload_images(docs)
+    assert images == [f"{registry}/apps/web:v1", "unreachable.invalid/x:1"]
+    rows = k8s.scan_images(images, insecure=True, scanners=["secret"])
+    by_image = {r["image"]: r for r in rows}
+    ok = by_image[f"{registry}/apps/web:v1"]
+    assert not ok["error"]
+    assert sum(ok["severities"].values()) >= 1  # the planted github-pat
+    bad = by_image["unreachable.invalid/x:1"]
+    assert bad["error"]  # degraded, not crashed

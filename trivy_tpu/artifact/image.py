@@ -146,9 +146,8 @@ class ImageArchiveArtifact:
     def __init__(self, path: str, cache, option: ArtifactOption | None = None):
         if not os.path.exists(path):
             raise FileNotFoundError(
-                f"image archive not found: {path} (daemon/registry pulls are "
-                "not supported in this build; use 'docker save' output or an "
-                "OCI layout)"
+                f"image archive not found: {path} (for remote images use a "
+                "registry reference, e.g. localhost:5000/app:latest)"
             )
         self.path = path
         self.cache = cache
@@ -160,6 +159,10 @@ class ImageArchiveArtifact:
         self.walker = LayerTarWalker(
             skip_files=self.option.skip_files, skip_dirs=self.option.skip_dirs
         )
+
+    def _open_source(self):
+        """Archive-like image source; registry subclass overrides."""
+        return _ImageArchive(self.path)
 
     # -- per-layer analysis --------------------------------------------------
 
@@ -190,7 +193,7 @@ class ImageArchiveArtifact:
         passes shared ones to avoid per-layer reopen/rebuild."""
         own_archive = archive is None
         if own_archive:
-            archive = _ImageArchive(self.path)
+            archive = self._open_source()
         if group is None:
             group = self._layer_group(skip_secret)
         try:
@@ -228,7 +231,7 @@ class ImageArchiveArtifact:
     # -- inspect -------------------------------------------------------------
 
     def inspect(self) -> ArtifactReference:
-        archive = _ImageArchive(self.path)
+        archive = self._open_source()
         try:
             versions = self.group.versions()
             hooks = self.handlers.versions()
@@ -349,3 +352,44 @@ def _base_layer_indices(histories: list[dict]) -> set[int]:
                 out.add(layer)
             layer += 1
     return out
+
+
+class ImageRegistryArtifact(ImageArchiveArtifact):
+    """Container image pulled straight from an OCI registry (ref:
+    pkg/fanal/image/image.go remote source); identical per-layer pipeline
+    and cache keys, only the byte source differs."""
+
+    def __init__(self, ref: str, cache, option: ArtifactOption | None = None):
+        self.path = ref
+        self.cache = cache
+        self.option = option or ArtifactOption()
+        self.group = self._layer_group(False)
+        self.handlers = HandlerManager()
+        self.walker = LayerTarWalker(
+            skip_files=self.option.skip_files, skip_dirs=self.option.skip_dirs
+        )
+
+    def _open_source(self):
+        # one shared instance: HTTP pulls are thread-safe (unlike tarfile
+        # handles), and re-opening would refetch manifest+config+token per
+        # layer in the parallel path
+        cached = getattr(self, "_source", None)
+        if cached is None:
+            from trivy_tpu.fanal.image_registry import RegistryImage
+
+            cached = self._source = RegistryImage(
+                self.path,
+                insecure=getattr(self.option, "insecure_registry", False),
+                username=getattr(self.option, "registry_username", ""),
+                password=getattr(self.option, "registry_password", ""),
+                platform=getattr(self.option, "platform", ""),
+            )
+        return cached
+
+
+def new_image_artifact(target: str, cache, option: ArtifactOption | None = None):
+    """Archive path when it exists on disk, else a registry reference —
+    the resolution-order analog of pkg/fanal/image/image.go:27-58."""
+    if os.path.exists(target):
+        return ImageArchiveArtifact(target, cache, option)
+    return ImageRegistryArtifact(target, cache, option)

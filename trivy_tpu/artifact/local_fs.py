@@ -35,6 +35,11 @@ class ArtifactOption:
     insecure: bool = False
     analyzer_extra: dict = field(default_factory=dict)
     parallel: int = 0  # host worker count (--parallel); 0 = defaults
+    # registry image source options
+    insecure_registry: bool = False
+    registry_username: str = ""
+    registry_password: str = ""
+    platform: str = ""
 
 
 class LocalFSArtifact:

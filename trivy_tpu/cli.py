@@ -146,6 +146,23 @@ def db_flags() -> FlagGroup:
     )
 
 
+def image_flags() -> FlagGroup:
+    return FlagGroup(
+        "image",
+        [
+            Flag("insecure", default=False, value_type=bool,
+                 config_name="image.insecure",
+                 help="allow plain-HTTP / self-signed registries"),
+            Flag("username", default=None, config_name="image.username",
+                 help="registry basic-auth username"),
+            Flag("password", default=None, config_name="image.password",
+                 help="registry basic-auth password"),
+            Flag("platform", default=None, config_name="image.platform",
+                 help="platform for multi-arch images (os/arch)"),
+        ],
+    )
+
+
 def server_client_flags() -> FlagGroup:
     return FlagGroup(
         "client/server",
@@ -166,7 +183,8 @@ _TARGET_GROUPS = {
     "repo": [global_flags, scan_flags, report_flags, secret_flags,
              license_flags, misconf_flags, db_flags, server_client_flags],
     "image": [global_flags, scan_flags, report_flags, secret_flags,
-              license_flags, misconf_flags, db_flags, server_client_flags],
+              license_flags, misconf_flags, db_flags, server_client_flags,
+              image_flags],
     "vm": [global_flags, scan_flags, report_flags, secret_flags,
            license_flags, misconf_flags, db_flags, server_client_flags],
     "sbom": [global_flags, scan_flags, report_flags, db_flags,
@@ -188,7 +206,7 @@ def build_parser() -> argparse.ArgumentParser:
         "fs": "scan a local filesystem",
         "rootfs": "scan an exported root filesystem",
         "repo": "scan a git repository (local path or remote URL)",
-        "image": "scan a container image (archive or OCI layout)",
+        "image": "scan a container image (archive, OCI layout, or registry ref)",
         "vm": "scan a VM disk image (raw; MBR/GPT + ext4)",
         "sbom": "scan an SBOM (CycloneDX/SPDX) for vulnerabilities",
         "convert": "convert a saved JSON report into another format",
@@ -232,6 +250,12 @@ def build_parser() -> argparse.ArgumentParser:
     kp.add_argument("--context", default=None, help="kubectl context (live cluster)")
     kp.add_argument("--format", default="table", choices=["table", "json"])
     kp.add_argument("-o", "--output", default=None)
+    kp.add_argument("--scan-images", action="store_true",
+                    help="also pull and scan workload images (registry source)")
+    kp.add_argument("--insecure", action="store_true",
+                    help="allow plain-HTTP registries for image pulls")
+    kp.add_argument("--db-repository", default=None,
+                    help="advisory DB location for image vulnerability scans")
 
     pp = sub.add_parser("plugin", help="manage plugins (install/list/run/uninstall)")
     psub = pp.add_subparsers(dest="plugin_cmd")
@@ -276,11 +300,24 @@ def main(argv: list[str] | None = None) -> int:
             log.logger("cli").error("%s", e)
             return 1
         rows = k8s.scan_workloads(docs)
+        image_rows = None
+        if ns.scan_images:
+            from trivy_tpu.db import load_default_db
+
+            db = load_default_db(ns.db_repository, None)
+            if db is None:
+                log.logger("cli").warning(
+                    "no advisory DB found; image scans report secrets only "
+                    "(--db-repository to supply one)"
+                )
+            image_rows = k8s.scan_images(
+                k8s.workload_images(docs), insecure=ns.insecure, db=db,
+            )
         if ns.output:
             with open(ns.output, "w") as f:
-                k8s.write_summary(rows, f, ns.format)
+                k8s.write_summary(rows, f, ns.format, image_rows)
         else:
-            k8s.write_summary(rows, _sys.stdout, ns.format)
+            k8s.write_summary(rows, _sys.stdout, ns.format, image_rows)
         return 0
     if ns.command == "plugin":
         from trivy_tpu import plugin

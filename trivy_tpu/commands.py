@@ -60,6 +60,10 @@ def _artifact_option(ns, opts):
             "java_db_path": opts.get("java_db"),
         },
         parallel=max(0, int(opts.get("parallel") or 0)),
+        insecure_registry=bool(opts.get("insecure")),
+        registry_username=opts.get("username", "") or "",
+        registry_password=opts.get("password", "") or "",
+        platform=opts.get("platform", "") or "",
     )
 
 
@@ -245,7 +249,7 @@ def _run_fs_like(command: str, ns, opts) -> int:
 
 
 def _run_image(ns, opts) -> int:
-    from trivy_tpu.artifact.image import ImageArchiveArtifact
+    from trivy_tpu.artifact.image import ImageArchiveArtifact, new_image_artifact
     from trivy_tpu.scanner.local_driver import LocalDriver
 
     target = getattr(ns, "input", None) or ns.target
@@ -253,7 +257,7 @@ def _run_image(ns, opts) -> int:
         logger.error("specify an image archive path (positional or --input)")
         return 1
     cache = _make_cache(opts)
-    artifact = ImageArchiveArtifact(target, cache, _artifact_option(ns, opts))
+    artifact = new_image_artifact(target, cache, _artifact_option(ns, opts))
     driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
     report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)

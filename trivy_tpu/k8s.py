@@ -15,8 +15,9 @@ Sources, in order of preference:
 Each workload document runs through the misconfiguration engine's
 kubernetes checks; results aggregate into per-resource rows with a
 severity summary, like the reference's summary writer (pkg/k8s/report).
-Image vulnerability scanning of cluster workloads requires registry pulls
-(egress) and is out of scope here.
+Workload images additionally scan through the registry image source
+(``--scan-images``; fanal/image_registry.py pulls them straight from
+their registries, matching pkg/k8s scanning images per resource).
 """
 
 from __future__ import annotations
@@ -142,23 +143,32 @@ def scan_workloads(docs: list[dict], scanner: MisconfScanner | None = None):
     return rows
 
 
-def write_summary(rows: list[dict], out, fmt: str = "table") -> None:
+def write_summary(rows: list[dict], out, fmt: str = "table",
+                  image_rows: list[dict] | None = None) -> None:
     if fmt == "json":
-        json.dump(
-            {
-                "Resources": [
-                    {
-                        "Namespace": r["namespace"],
-                        "Kind": r["kind"],
-                        "Name": r["name"],
-                        "Summary": r["severities"],
-                        "Misconfigurations": [f.to_dict() for f in r["failures"]],
-                    }
-                    for r in rows
-                ],
-            },
-            out, indent=2,
-        )
+        doc = {
+            "Resources": [
+                {
+                    "Namespace": r["namespace"],
+                    "Kind": r["kind"],
+                    "Name": r["name"],
+                    "Summary": r["severities"],
+                    "Misconfigurations": [f.to_dict() for f in r["failures"]],
+                }
+                for r in rows
+            ],
+        }
+        if image_rows is not None:
+            doc["Images"] = [
+                {
+                    "Image": r["image"],
+                    "Summary": r["severities"],
+                    "Findings": r["findings"],
+                    "Error": r["error"],
+                }
+                for r in image_rows
+            ]
+        json.dump(doc, out, indent=2)
         out.write("\n")
         return
     out.write("\nWorkload Assessment\n")
@@ -175,3 +185,78 @@ def write_summary(rows: list[dict], out, fmt: str = "table") -> None:
         )
     total = sum(sum(r["severities"].values()) for r in rows)
     out.write(f"\n{len(rows)} workloads, {total} misconfigurations\n")
+    if image_rows is not None:
+        write_image_summary(image_rows, out)
+
+
+def write_image_summary(image_rows: list[dict], out) -> None:
+    out.write("\nWorkload Images\n")
+    for r in image_rows:
+        sev = " ".join(f"{k[0]}:{v}" for k, v in r["severities"].items() if v)
+        status = r["error"] or (sev or "clean")
+        out.write(f"  {r['image']:<52} {status}\n")
+
+
+def workload_images(docs: list[dict]) -> list[str]:
+    """Unique container image references across workload pod specs."""
+    images: set[str] = set()
+    for doc in docs:
+        if doc.get("kind") not in WORKLOAD_KINDS:
+            continue
+        spec = doc.get("spec", {}) or {}
+        pod = spec
+        # walk template chains (Deployment -> template -> spec, CronJob ->
+        # jobTemplate -> template -> spec)
+        for key in ("jobTemplate", "template"):
+            t = pod.get(key)
+            if isinstance(t, dict):
+                pod = t.get("spec", t) or {}
+        for ckey in ("containers", "initContainers", "ephemeralContainers"):
+            for c in pod.get(ckey, []) or []:
+                if isinstance(c, dict) and c.get("image"):
+                    images.add(str(c["image"]))
+    return sorted(images)
+
+
+def scan_images(images: list[str], cache_dir: str | None = None,
+                insecure: bool = False, scanners: list[str] | None = None,
+                db=None) -> list[dict]:
+    """Scan workload images via the registry source; per-image rows with a
+    vulnerability/secret severity summary (pkg/k8s image scanning analog).
+    Unreachable images degrade to an error row, never a failed scan."""
+    from trivy_tpu.artifact.image import new_image_artifact
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver, ScanOptions
+
+    scanners = scanners or ["vuln", "secret"]
+    cache = new_cache("fs" if cache_dir else "memory", cache_dir)
+    rows: list[dict] = []
+    for image in images:
+        sev = {s: 0 for s in SEVERITIES}
+        try:
+            art = new_image_artifact(
+                image, cache,
+                ArtifactOption(insecure_registry=insecure),
+            )
+            report = Scanner(art, LocalDriver(cache, vuln_client=db)).scan_artifact(
+                ScanOptions(scanners=scanners)
+            )
+            findings = []
+            for r in report.results:
+                for v in r.vulnerabilities:
+                    s = v.severity if v.severity in sev else "UNKNOWN"
+                    sev[s] += 1
+                    findings.append(v.to_dict())
+                for s_f in r.secrets:
+                    s = s_f.severity if s_f.severity in sev else "UNKNOWN"
+                    sev[s] += 1
+                    findings.append(s_f.to_dict())
+            rows.append({"image": image, "severities": sev,
+                         "findings": findings, "error": ""})
+        except Exception as e:
+            logger.warning("image scan failed for %s: %s", image, e)
+            rows.append({"image": image, "severities": sev,
+                         "findings": [], "error": str(e)})
+    return rows
