@@ -1,0 +1,321 @@
+"""Round-4 dependency graphs: depends_on edges per lockfile format,
+relationship classification, --dependency-tree rendering, and CycloneDX
+dependsOn round-trip (ref: pkg/dependency/relationship.go,
+pkg/sbom/io/encode.go)."""
+
+import io
+import json
+
+from trivy_tpu.dependency import parsers
+
+
+def by_id(pkgs):
+    return {p.id: p for p in pkgs}
+
+
+def test_npm_v3_edges_and_relationships():
+    lock = {
+        "name": "app", "lockfileVersion": 3,
+        "packages": {
+            "": {"name": "app", "dependencies": {"a": "^1.0.0"},
+                 "devDependencies": {"d": "^1.0.0"}},
+            "node_modules/a": {"version": "1.0.0",
+                               "dependencies": {"b": "^2.0.0"}},
+            # hoisted transitive: top level but NOT declared by the root
+            "node_modules/b": {"version": "2.0.0"},
+            "node_modules/d": {"version": "1.0.0", "dev": True},
+            # nested duplicate resolution
+            "node_modules/a/node_modules/b": {"version": "2.5.0"},
+        },
+    }
+    pkgs = by_id(parsers.parse_npm_lock(json.dumps(lock).encode()))
+    assert pkgs["a@1.0.0"].relationship == "direct"
+    assert pkgs["b@2.0.0"].relationship == "indirect"  # hoisted, not direct
+    assert pkgs["d@1.0.0"].relationship == "direct"
+    # nearest-scope resolution: a's b edge goes to the nested 2.5.0
+    assert pkgs["a@1.0.0"].depends_on == ["b@2.5.0"]
+
+
+def test_npm_v1_edges():
+    lock = {
+        "dependencies": {
+            "a": {"version": "1.0.0", "requires": {"b": "^2.0.0"},
+                  "dependencies": {"b": {"version": "2.5.0"}}},
+            "b": {"version": "2.0.0"},
+        },
+    }
+    pkgs = by_id(parsers.parse_npm_lock(json.dumps(lock).encode()))
+    assert pkgs["a@1.0.0"].depends_on == ["b@2.5.0"]
+    assert pkgs["a@1.0.0"].relationship == "direct"
+    assert pkgs["b@2.5.0"].relationship == "indirect"
+
+
+def test_yarn_edges():
+    lock = b'''# yarn lockfile v1
+
+a@^1.0.0:
+  version "1.0.3"
+  resolved "https://registry/a.tgz"
+  dependencies:
+    b "^2.0.0"
+    c "~3.0.0"
+
+b@^2.0.0:
+  version "2.4.1"
+
+c@~3.0.0, c@^3.0.1:
+  version "3.0.5"
+'''
+    pkgs = by_id(parsers.parse_yarn_lock(lock))
+    assert pkgs["a@1.0.3"].depends_on == ["b@2.4.1", "c@3.0.5"]
+    assert pkgs["c@3.0.5"].depends_on == []
+
+
+def test_pnpm_v6_edges():
+    lock = b'''lockfileVersion: '6.0'
+packages:
+  /a@1.0.0:
+    resolution: {integrity: sha512-x}
+    dependencies:
+      b: 2.0.0
+  /b@2.0.0:
+    resolution: {integrity: sha512-y}
+'''
+    pkgs = by_id(parsers.parse_pnpm_lock(lock))
+    assert pkgs["a@1.0.0"].depends_on == ["b@2.0.0"]
+
+
+def test_pnpm_v9_snapshot_edges():
+    lock = b'''lockfileVersion: '9.0'
+packages:
+  a@1.0.0:
+    resolution: {integrity: sha512-x}
+  b@2.0.0:
+    resolution: {integrity: sha512-y}
+snapshots:
+  a@1.0.0:
+    dependencies:
+      b: 2.0.0
+  b@2.0.0: {}
+'''
+    pkgs = by_id(parsers.parse_pnpm_lock(lock))
+    assert pkgs["a@1.0.0"].depends_on == ["b@2.0.0"]
+
+
+def test_poetry_edges():
+    lock = b'''[[package]]
+name = "flask"
+version = "2.3.0"
+
+[package.dependencies]
+werkzeug = ">=2.3"
+
+[[package]]
+name = "werkzeug"
+version = "2.3.4"
+'''
+    pkgs = by_id(parsers.parse_poetry_lock(lock))
+    assert pkgs["flask@2.3.0"].depends_on == ["werkzeug@2.3.4"]
+
+
+def test_cargo_edges_with_versioned_dep():
+    lock = b'''[[package]]
+name = "serde"
+version = "1.0.190"
+dependencies = [
+ "serde_derive 1.0.190",
+]
+
+[[package]]
+name = "serde_derive"
+version = "1.0.190"
+'''
+    pkgs = by_id(parsers.parse_cargo_lock(lock))
+    assert pkgs["serde@1.0.190"].depends_on == ["serde_derive@1.0.190"]
+
+
+def test_composer_edges():
+    lock = {
+        "packages": [
+            {"name": "monolog/monolog", "version": "v3.5.0",
+             "require": {"php": ">=8.1", "psr/log": "^2.0"}},
+            {"name": "psr/log", "version": "v2.0.0"},
+        ],
+        "packages-dev": [],
+    }
+    pkgs = by_id(parsers.parse_composer_lock(json.dumps(lock).encode()))
+    # php platform requirement has no lock entry -> not an edge
+    assert pkgs["monolog/monolog@3.5.0"].depends_on == ["psr/log@2.0.0"]
+
+
+def test_dependency_tree_rendering():
+    from trivy_tpu.report.table import write_table
+    from trivy_tpu.types import (
+        DetectedVulnerability, Package, Report, Result,
+    )
+
+    pkgs = [
+        Package(name="framework", version="2.0.0", id="framework@2.0.0",
+                relationship="direct", depends_on=["lodash@4.17.20"]),
+        Package(name="lodash", version="4.17.20", id="lodash@4.17.20",
+                relationship="indirect"),
+    ]
+    vuln = DetectedVulnerability(
+        vulnerability_id="CVE-2021-23337", pkg_name="lodash",
+        pkg_id="lodash@4.17.20", installed_version="4.17.20",
+        severity="HIGH",
+    )
+    report = Report(artifact_name="x", artifact_type="filesystem", results=[
+        Result(target="package-lock.json", cls="lang-pkgs", type="npm",
+               packages=pkgs, vulnerabilities=[vuln]),
+    ])
+    out = io.StringIO()
+    write_table(report, out, dependency_tree=True)
+    text = out.getvalue()
+    assert "Dependency Origin Tree (Reversed)" in text
+    assert "lodash@4.17.20, (HIGH: 1)" in text
+    assert "framework@2.0.0 (direct)" in text
+
+
+def test_cyclonedx_depends_on_roundtrip():
+    from trivy_tpu.sbom.decode import decode_cyclonedx
+    from trivy_tpu.sbom.io import encode_cyclonedx
+    from trivy_tpu.types import Package, Report, Result
+
+    pkgs = [
+        Package(name="framework", version="2.0.0", id="framework@2.0.0",
+                depends_on=["lodash@4.17.20"]),
+        Package(name="lodash", version="4.17.20", id="lodash@4.17.20"),
+    ]
+    report = Report(artifact_name="app", artifact_type="filesystem", results=[
+        Result(target="package-lock.json", cls="lang-pkgs", type="npm",
+               packages=pkgs),
+    ])
+    doc = encode_cyclonedx(report)
+    deps = {d["ref"]: d["dependsOn"] for d in doc["dependencies"]}
+    assert deps == {"pkg:npm/framework@2.0.0": ["pkg:npm/lodash@4.17.20"]}
+    blob = decode_cyclonedx(doc)
+    decoded = {p.name: p for app in blob.applications for p in app.packages}
+    assert decoded["framework"].depends_on == ["lodash@4.17.20"]
+
+
+# -- round-4 new parsers ------------------------------------------------------
+
+
+def test_dotnet_deps_json():
+    doc = {
+        "targets": {".NETCoreApp,Version=v6.0": {}},
+        "libraries": {
+            "Newtonsoft.Json/13.0.3": {"type": "package"},
+            "MyApp/1.0.0": {"type": "project"},
+        },
+    }
+    pkgs = parsers.parse_dotnet_deps(json.dumps(doc).encode())
+    assert [(p.name, p.version) for p in pkgs] == [("Newtonsoft.Json", "13.0.3")]
+
+
+def test_julia_manifest():
+    manifest = b'''julia_version = "1.9.0"
+manifest_format = "2.0"
+
+[[deps.ArgTools]]
+uuid = "0dad84c5"
+version = "1.1.1"
+
+[[deps.HTTP]]
+deps = ["ArgTools", "Sockets"]
+uuid = "cd3eb016"
+version = "1.9.5"
+
+[[deps.Sockets]]
+uuid = "6462fe0b"
+'''
+    pkgs = parsers.parse_julia_manifest(manifest)
+    got = by_id(pkgs)
+    assert set(got) == {"ArgTools@1.1.1", "HTTP@1.9.5"}  # stdlib Sockets skipped
+    assert got["HTTP@1.9.5"].depends_on == ["ArgTools@1.1.1"]
+
+
+def test_sbt_lock():
+    doc = {
+        "lockVersion": 1,
+        "dependencies": [
+            {"org": "org.typelevel", "name": "cats-core_2.13",
+             "version": "2.9.0", "configurations": ["compile"]},
+        ],
+    }
+    pkgs = parsers.parse_sbt_lock(json.dumps(doc).encode())
+    assert [(p.name, p.version) for p in pkgs] == [
+        ("org.typelevel:cats-core_2.13", "2.9.0")
+    ]
+
+
+def test_conda_environment():
+    env = b'''name: myenv
+dependencies:
+  - numpy=1.24.3=py311h64a7726_0
+  - python>=3.10
+  - pip:
+    - requests==2.31.0
+'''
+    pkgs = parsers.parse_conda_environment(env)
+    got = {(p.name, p.version) for p in pkgs}
+    assert ("numpy", "1.24.3") in got
+    assert ("requests", "2.31.0") in got
+    assert ("python", "") in got  # unpinned spec kept nameonly
+
+
+def test_packages_props():
+    xml = b'''<Project>
+  <ItemGroup>
+    <PackageVersion Include="Serilog" Version="3.0.1" />
+    <PackageVersion Include="Templated" Version="$(SerilogVersion)" />
+  </ItemGroup>
+</Project>
+'''
+    pkgs = parsers.parse_packages_props(xml)
+    assert [(p.name, p.version) for p in pkgs] == [("Serilog", "3.0.1")]
+
+
+def test_yarn_berry():
+    lock = b'''# This file is generated by running "yarn install"
+
+__metadata:
+  version: 8
+  cacheKey: 10c0
+
+"app@workspace:.":
+  version: 0.0.0-use.local
+  dependencies:
+    lodash: "npm:^4.17.20"
+
+"lodash@npm:^4.17.20":
+  version: 4.17.21
+  dependencies:
+    helper: "npm:^1.0.0"
+
+"helper@npm:^1.0.0":
+  version: 1.2.0
+'''
+    pkgs = by_id(parsers.parse_yarn_lock(lock))
+    assert set(pkgs) == {"lodash@4.17.21", "helper@1.2.0"}
+    assert pkgs["lodash@4.17.21"].depends_on == ["helper@1.2.0"]
+
+
+def test_new_analyzers_wired():
+    from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions
+
+    group = AnalyzerGroup(AnalyzerOptions(backend="cpu"))
+    names = [
+        "app.deps.json", "Manifest.toml", "build.sbt.lock",
+        "environment.yml", "Directory.Packages.props",
+    ]
+    covered = set()
+    for a in group.analyzers:
+        for n in names:
+            try:
+                if a.required(n, None):
+                    covered.add(n)
+            except Exception:
+                pass
+    assert set(names) <= covered, covered

@@ -86,6 +86,10 @@ def report_flags() -> FlagGroup:
                  help="go-template style output template (for --format template)"),
             Flag("list-all-pkgs", default=False, value_type=bool,
                  config_name="list-all-pkgs", help="include all packages in report"),
+            Flag("dependency-tree", default=False, value_type=bool,
+                 config_name="dependency-tree",
+                 help="show the reversed dependency origin tree for "
+                      "vulnerable packages (table format)"),
             Flag("compliance", default=None, config_name="compliance",
                  help="render a compliance report (docker-cis-1.6.0, "
                       "k8s-nsa-1.0, or @spec.yaml)"),

@@ -70,8 +70,10 @@ def _artifact_option(ns, opts):
 def _scan_options(opts) -> ScanOptions:
     # SBOM/snapshot formats need the full package inventory (ref:
     # flag/report_flags.go forces ListAllPkgs for sbom formats)
-    list_all = bool(opts.get("list_all_pkgs")) or opts.get("format") in (
-        "cyclonedx", "spdx", "spdx-json", "github",
+    list_all = (
+        bool(opts.get("list_all_pkgs"))
+        or bool(opts.get("dependency_tree"))  # the tree needs the inventory
+        or opts.get("format") in ("cyclonedx", "spdx", "spdx-json", "github")
     )
     return ScanOptions(
         scanners=opts.get("scanners", ["secret"]),
@@ -195,6 +197,8 @@ def _emit(report, ns, opts) -> int:
         kw["template"] = opts["template"]
     if opts.get("show_suppressed"):
         kw["show_suppressed"] = True
+    if opts.get("dependency_tree"):
+        kw["dependency_tree"] = True
     if output:
         with open(output, "w") as f:
             report_pkg.write(report, opts.get("format", "table"), f, **kw)

@@ -55,85 +55,232 @@ def parse_gomod(content: bytes, path: str = "") -> list[Package]:
 
 
 def parse_npm_lock(content: bytes, path: str = "") -> list[Package]:
+    """package-lock.json with full dependency edges: the lockfile's
+    node_modules layout encodes npm's resolution algorithm, so each
+    entry's dependencies resolve by walking up the nesting chain
+    (ref: pkg/dependency/parser/nodejs/npm resolution + relationship.go
+    direct/indirect split from the root entry's declared deps)."""
     doc = json.loads(content)
     out: dict[tuple[str, str], Package] = {}
     if "packages" in doc:  # lockfile v2/v3
-        for loc, meta in doc["packages"].items():
+        locs = doc["packages"]
+
+        def name_of(loc: str, meta: dict) -> str:
+            return meta.get("name") or loc.split("node_modules/")[-1]
+
+        def resolve(loc: str, dep: str) -> str | None:
+            """Nearest node_modules/<dep> walking up from ``loc``."""
+            base = loc
+            while True:
+                cand = (base + "/" if base else "") + f"node_modules/{dep}"
+                meta = locs.get(cand)
+                if meta is not None and meta.get("version"):
+                    return f"{dep}@{meta['version']}"
+                if not base:
+                    return None
+                if "/node_modules/" in base:
+                    base = base.rsplit("/node_modules/", 1)[0]
+                else:
+                    # top-level node_modules/x OR a workspace dir
+                    # (packages/a): both resolve against the root scope next
+                    base = ""
+
+        root = locs.get("", {}) or {}
+        root_deps = set(root.get("dependencies", {}) or {}) | set(
+            root.get("devDependencies", {}) or {}
+        ) | set(root.get("optionalDependencies", {}) or {})
+        for loc, meta in locs.items():
             if not loc:  # "" is the root project
                 continue
-            name = meta.get("name") or loc.split("node_modules/")[-1]
+            name = name_of(loc, meta)
             version = meta.get("version", "")
             if not version:
                 continue
             key = (name, version)
             if key not in out:
-                out[key] = _pkg(
-                    name,
-                    version,
+                # direct = declared by the root project; nesting depth alone
+                # misclassifies hoisted transitive deps as direct
+                direct = loc == f"node_modules/{name}" and name in root_deps
+                p = _pkg(
+                    name, version,
                     dev=bool(meta.get("dev")),
-                    indirect="node_modules/" in loc.replace(f"node_modules/{name}", "", 1),
+                    indirect=not direct,
                 )
+                p.relationship = "direct" if direct else "indirect"
+                deps = set(meta.get("dependencies", {}) or {}) | set(
+                    meta.get("optionalDependencies", {}) or {}
+                )
+                p.depends_on = sorted(
+                    d for d in (resolve(loc, dep) for dep in deps) if d
+                )
+                out[key] = p
     else:  # lockfile v1: nested dependencies
-        def walk(deps: dict, depth: int):
+        def walk(deps: dict, depth: int, chain: list[dict]):
             for name, meta in (deps or {}).items():
                 version = meta.get("version", "")
                 if version:
                     key = (name, version)
                     if key not in out:
-                        out[key] = _pkg(
-                            name, version, dev=bool(meta.get("dev")), indirect=depth > 0
+                        p = _pkg(
+                            name, version, dev=bool(meta.get("dev")),
+                            indirect=depth > 0,
                         )
-                walk(meta.get("dependencies", {}), depth + 1)
+                        p.relationship = "direct" if depth == 0 else "indirect"
+                        edges = []
+                        for dep in meta.get("requires", {}) or {}:
+                            # nearest enclosing resolution, v1 style
+                            for scope in [meta.get("dependencies", {})] + [
+                                c for c in reversed(chain)
+                            ] + [deps]:
+                                m2 = (scope or {}).get(dep)
+                                if m2 and m2.get("version"):
+                                    edges.append(f"{dep}@{m2['version']}")
+                                    break
+                        p.depends_on = sorted(set(edges))
+                        out[key] = p
+                walk(meta.get("dependencies", {}), depth + 1,
+                     chain + [meta.get("dependencies", {})])
 
-        walk(doc.get("dependencies", {}), 0)
+        top = doc.get("dependencies", {})
+        walk(top, 0, [top])
     return [out[k] for k in sorted(out)]
 
 
 # --- yarn.lock (classic v1 format, ref: parser/nodejs/yarn) -----------------
 
-_YARN_HEADER = re.compile(r'^"?(?P<name>(?:@[^@/"]+/)?[^@/"]+)@')
+_YARN_HEADER = re.compile(r'^"?(?P<name>(?:@[^@/"]+/)?[^@/"]+)@(?P<range>[^",]*)')
 _YARN_VERSION = re.compile(r'^\s{2}version:?\s+"?(?P<v>[^"\s]+)"?')
+_YARN_DEP = re.compile(
+    r'^\s{4}"?(?P<name>(?:@[^@/"\s]+/)?[^@/":\s]+)"?:?\s+'
+    r'(?:"(?P<qrange>[^"]+)"|(?P<range>\S+))'
+)
+
+
+def _yarn_range(r: str) -> str:
+    """Normalize a selector range: berry prefixes ranges with a protocol
+    (npm:^1.0.0); classic has the bare range."""
+    return r[4:] if r.startswith("npm:") else r
 
 
 def parse_yarn_lock(content: bytes, path: str = "") -> list[Package]:
-    out: dict[tuple[str, str], Package] = {}
-    name = None
+    """yarn.lock (classic v1 and berry v2+) with dependency edges: each
+    entry's ``dependencies:`` ranges resolve through the lockfile's own
+    (name, range) -> version map (ref: pkg/dependency/parser/nodejs/yarn).
+    Berry's ``name@npm:range`` selectors normalize to bare ranges."""
+    # pass 1: entries with their selector sets and declared deps
+    entries: list[dict] = []
+    cur: dict | None = None
+    in_deps = False
     for line in content.decode("utf-8", "replace").splitlines():
         if not line.strip() or line.lstrip().startswith("#"):
             continue
         if not line.startswith(" "):
-            m = _YARN_HEADER.match(line.strip().rstrip(":"))
-            name = m.group("name") if m else None
+            selectors = []
+            for sel in line.strip().rstrip(":").split(","):
+                m = _YARN_HEADER.match(sel.strip())
+                if m:
+                    selectors.append((m.group("name"), _yarn_range(m.group("range"))))
+            cur = {"selectors": selectors, "version": "", "deps": []}
+            entries.append(cur)
+            in_deps = False
             continue
-        m = _YARN_VERSION.match(line)
-        if m and name:
-            key = (name, m.group("v"))
-            out.setdefault(key, _pkg(name, m.group("v")))
+        if cur is None:
+            continue
+        if line.startswith("  ") and not line.startswith("   "):
+            in_deps = line.strip() in ("dependencies:", "optionalDependencies:")
+            m = _YARN_VERSION.match(line)
+            if m:
+                cur["version"] = m.group("v")
+            continue
+        if in_deps:
+            m = _YARN_DEP.match(line)
+            if m:
+                rng = m.group("qrange") or m.group("range") or ""
+                cur["deps"].append((m.group("name"), _yarn_range(rng)))
+    # (name, range) -> version, plus name -> versions fallback
+    by_selector: dict[tuple[str, str], str] = {}
+    by_name: dict[str, set[str]] = {}
+    for e in entries:
+        if not e["version"]:
+            continue
+        for sel in e["selectors"]:
+            by_selector[sel] = e["version"]
+            by_name.setdefault(sel[0], set()).add(e["version"])
+
+    out: dict[tuple[str, str], Package] = {}
+    for e in entries:
+        if not e["selectors"] or not e["version"]:
+            continue
+        if any(r.startswith(("workspace:", "patch:")) for _n, r in e["selectors"]):
+            continue  # berry local workspaces/patches are not packages
+        name = e["selectors"][0][0]
+        key = (name, e["version"])
+        if key in out:
+            continue
+        edges = []
+        for dep_name, dep_range in e["deps"]:
+            v = by_selector.get((dep_name, dep_range))
+            if v is None:
+                versions = by_name.get(dep_name, set())
+                v = next(iter(versions)) if len(versions) == 1 else None
+            if v is not None:
+                edges.append(f"{dep_name}@{v}")
+        p = _pkg(name, e["version"])
+        p.depends_on = sorted(set(edges))
+        out[key] = p
     return [out[k] for k in sorted(out)]
 
 
 # --- pnpm-lock.yaml (v6/v9 key styles, ref: parser/nodejs/pnpm) -------------
 
 
+def _pnpm_key_to_nv(key: str) -> tuple[str, str]:
+    key = key.strip().split("(", 1)[0]  # drop peer-dep suffix: name@ver(peer@x)
+    if key.startswith("/"):  # v5/v6: /name@version or /name/version
+        body = key[1:]
+        if "@" in body[1:]:
+            name, _, version = body.rpartition("@")
+        else:
+            name, _, version = body.rpartition("/")
+    else:  # v9: name@version
+        name, _, version = key.rpartition("@")
+    return name, version.split("(", 1)[0]
+
+
 def parse_pnpm_lock(content: bytes, path: str = "") -> list[Package]:
+    """pnpm-lock.yaml with dependency edges: v5-v6 carry per-package
+    ``dependencies`` maps inline; v9 moves them into ``snapshots``
+    (ref: pkg/dependency/parser/nodejs/pnpm)."""
     import yaml
 
     doc = yaml.safe_load(content) or {}
+    packages = doc.get("packages") or {}
+    snapshots = doc.get("snapshots") or {}
     out: dict[tuple[str, str], Package] = {}
-    for key in (doc.get("packages") or {}):
-        key = key.strip()
-        name = version = ""
-        if key.startswith("/"):  # v5/v6: /name@version or /name/version
-            body = key[1:]
-            if "@" in body[1:]:
-                name, _, version = body.rpartition("@")
-            else:
-                name, _, version = body.rpartition("/")
-        else:  # v9: name@version
-            name, _, version = key.rpartition("@")
-        version = version.split("(", 1)[0]
+
+    def edges_of(meta) -> list[str]:
+        if not isinstance(meta, dict):
+            return []
+        deps = dict(meta.get("dependencies") or {})
+        deps.update(meta.get("optionalDependencies") or {})
+        edges = []
+        for dname, dver in deps.items():
+            v = str(dver).split("(", 1)[0]
+            if v.startswith("/"):  # aliased: /real-name@version
+                dname, v = _pnpm_key_to_nv(v)
+            if v:
+                edges.append(f"{dname}@{v}")
+        return sorted(set(edges))
+
+    snap_edges = {
+        _pnpm_key_to_nv(k): edges_of(meta) for k, meta in snapshots.items()
+    }
+    for key, meta in packages.items():
+        name, version = _pnpm_key_to_nv(key)
         if name and version:
-            out.setdefault((name, version), _pkg(name, version))
+            p = _pkg(name, version)
+            p.depends_on = snap_edges.get((name, version)) or edges_of(meta)
+            out.setdefault((name, version), p)
     return [out[k] for k in sorted(out)]
 
 
@@ -172,15 +319,49 @@ def parse_pipfile_lock(content: bytes, path: str = "") -> list[Package]:
 
 
 def _parse_toml_packages(content: bytes, dev_groups: bool = False) -> list[Package]:
+    """Lockfiles of [[package]] entries (poetry/uv/cargo), with dependency
+    edges resolved by name against the lock's own entries (versions are
+    pinned, so name -> version is unambiguous except for multi-version
+    cargo graphs, where an exact "name version" spec disambiguates)."""
     import tomllib
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
+    entries = doc.get("package", []) or []
+    by_name: dict[str, list[str]] = {}
+    for entry in entries:
+        if entry.get("name") and entry.get("version"):
+            by_name.setdefault(entry["name"], []).append(entry["version"])
     pkgs = []
-    for entry in doc.get("package", []) or []:
+    for entry in entries:
         name, version = entry.get("name"), entry.get("version")
-        if name and version:
-            dev = entry.get("category") == "dev" if dev_groups else False
-            pkgs.append(_pkg(name, version, dev=dev))
+        if not (name and version):
+            continue
+        dev = entry.get("category") == "dev" if dev_groups else False
+        p = _pkg(name, version, dev=dev)
+        edges = []
+        deps = entry.get("dependencies")
+        if isinstance(deps, dict):  # poetry: {name: spec}
+            for dname in deps:
+                vs = by_name.get(dname, [])
+                if len(vs) == 1:
+                    edges.append(f"{dname}@{vs[0]}")
+        elif isinstance(deps, list):  # cargo/uv: "name" or "name version" or {name=...}
+            for d in deps:
+                if isinstance(d, dict):
+                    dname, dver = d.get("name"), d.get("version", "")
+                else:
+                    dname, _, dver = str(d).partition(" ")
+                    dver = dver.split(" ", 1)[0]
+                if not dname:
+                    continue
+                if dver:
+                    edges.append(f"{dname}@{dver}")
+                else:
+                    vs = by_name.get(dname, [])
+                    if len(vs) == 1:
+                        edges.append(f"{dname}@{vs[0]}")
+        p.depends_on = sorted(set(edges))
+        pkgs.append(p)
     return pkgs
 
 
@@ -223,15 +404,29 @@ def parse_gemfile_lock(content: bytes, path: str = "") -> list[Package]:
 
 def parse_composer_lock(content: bytes, path: str = "") -> list[Package]:
     doc = json.loads(content)
+    versions: dict[str, str] = {}
+    for section in ("packages", "packages-dev"):
+        for meta in doc.get(section, []) or []:
+            if meta.get("name") and meta.get("version"):
+                versions[meta["name"]] = str(meta["version"]).lstrip("v")
     pkgs = []
     for section, dev in (("packages", False), ("packages-dev", True)):
         for meta in doc.get(section, []) or []:
             name, ver = meta.get("name"), str(meta.get("version", "")).lstrip("v")
             if name and ver:
                 lic = meta.get("license") or []
-                pkgs.append(
-                    _pkg(name, ver, dev=dev, licenses=lic if isinstance(lic, list) else [lic])
+                p = _pkg(
+                    name, ver, dev=dev,
+                    licenses=lic if isinstance(lic, list) else [lic],
                 )
+                # edges: require entries that resolve to locked packages
+                # (php/ext-* platform requirements have no lock entry)
+                p.depends_on = sorted(
+                    f"{d}@{versions[d]}"
+                    for d in (meta.get("require") or {})
+                    if d in versions
+                )
+                pkgs.append(p)
     return pkgs
 
 
@@ -370,4 +565,128 @@ def parse_swift_resolved(content: bytes, path: str = "") -> list[Package]:
         ver = (pin.get("state") or {}).get("version", "")
         if name and ver:
             pkgs.append(_pkg(name.removesuffix(".git"), ver))
+    return pkgs
+
+
+# --- dotnet *.deps.json (ref: parser/dotnet/core_deps/parse.go) -------------
+
+
+def parse_dotnet_deps(content: bytes, path: str = "") -> list[Package]:
+    """.NET runtime dependency file: ``libraries`` entries of type
+    "package" are the restored NuGet packages."""
+    doc = json.loads(content)
+    pkgs = []
+    for key, meta in (doc.get("libraries") or {}).items():
+        if (meta or {}).get("type") != "package":
+            continue
+        name, _, version = key.partition("/")
+        if name and version:
+            pkgs.append(_pkg(name, version))
+    pkgs.sort(key=lambda p: (p.name, p.version))
+    return pkgs
+
+
+# --- julia Manifest.toml (ref: parser/julia/manifest/parse.go) --------------
+
+
+def parse_julia_manifest(content: bytes, path: str = "") -> list[Package]:
+    """Julia package manifest: [[deps.Name]] entries with uuid/version and
+    name-resolved dependency edges (stdlib entries carry no version)."""
+    import tomllib
+
+    doc = tomllib.loads(content.decode("utf-8", "replace"))
+    deps_tbl = doc.get("deps", doc)  # format 2 nests under [deps]; 1 is flat
+    if not isinstance(deps_tbl, dict):
+        return []
+    versions: dict[str, str] = {}
+    for name, entries in deps_tbl.items():
+        if isinstance(entries, list) and entries:
+            v = entries[0].get("version")
+            if v:
+                versions[name] = v
+    pkgs = []
+    for name, entries in sorted(deps_tbl.items()):
+        if not (isinstance(entries, list) and entries):
+            continue
+        entry = entries[0]
+        version = entry.get("version")
+        if not version:
+            continue  # stdlib / path deps
+        p = _pkg(name, version)
+        p.depends_on = sorted(
+            f"{d}@{versions[d]}"
+            for d in (entry.get("deps") or [])
+            if d in versions
+        )
+        pkgs.append(p)
+    return pkgs
+
+
+# --- sbt build.sbt.lock (ref: parser/sbt/lockfile/parse.go) -----------------
+
+
+def parse_sbt_lock(content: bytes, path: str = "") -> list[Package]:
+    doc = json.loads(content)
+    pkgs = []
+    seen = set()
+    for dep in doc.get("dependencies", []) or []:
+        org, name, version = dep.get("org"), dep.get("name"), dep.get("version")
+        if not (org and name and version):
+            continue
+        full = f"{org}:{name}"
+        if (full, version) in seen:
+            continue
+        seen.add((full, version))
+        pkgs.append(_pkg(full, version))
+    pkgs.sort(key=lambda p: (p.name, p.version))
+    return pkgs
+
+
+# --- conda environment.yml (ref: parser/conda/environment/parse.go) ---------
+
+_CONDA_SPEC = re.compile(
+    r"^(?P<name>[A-Za-z0-9_.-]+)\s*(?:=+\s*(?P<ver>[0-9][^=\s]*))?"
+)
+
+
+def parse_conda_environment(content: bytes, path: str = "") -> list[Package]:
+    """conda environment.yml: plain specs plus the nested pip list."""
+    import yaml
+
+    doc = yaml.safe_load(content) or {}
+    pkgs = []
+    for dep in doc.get("dependencies", []) or []:
+        if isinstance(dep, str):
+            m = _CONDA_SPEC.match(dep.strip())
+            if m and m.group("name"):
+                pkgs.append(_pkg(m.group("name"), m.group("ver") or ""))
+        elif isinstance(dep, dict):
+            for pip_spec in dep.get("pip", []) or []:
+                m = _REQ_LINE.match(str(pip_spec))
+                if m:
+                    pkgs.append(_pkg(m.group("name"), m.group("ver")))
+    pkgs.sort(key=lambda p: (p.name, p.version))
+    return pkgs
+
+
+# --- nuget Directory.Packages.props (ref: parser/nuget/config) --------------
+
+
+def parse_packages_props(content: bytes, path: str = "") -> list[Package]:
+    """Central package management props: <PackageVersion Include=... />."""
+    import xml.etree.ElementTree as ET
+
+    try:
+        root = ET.fromstring(content.decode("utf-8-sig", "replace"))
+    except ET.ParseError:
+        return []
+    pkgs = []
+    for el in root.iter():
+        if el.tag.rsplit("}", 1)[-1] not in ("PackageVersion", "PackageReference"):
+            continue
+        name = el.get("Include") or el.get("Update")
+        version = el.get("Version") or (el.findtext("Version") or "")
+        if name and version and "$(" not in version and "$(" not in name:
+            pkgs.append(_pkg(name, version))
+    pkgs.sort(key=lambda p: (p.name, p.version))
     return pkgs

@@ -81,6 +81,9 @@ class AnalyzerType(str, enum.Enum):
     PYTHON_PKG = "python-pkg"
     GEMSPEC = "gemspec"
     JULIA = "julia"
+    PACKAGES_PROPS = "packages-props"
+    CONDA_ENV = "conda-environment"
+    SBT_LOCK = "sbt-lockfile"
     # others
     SECRET = "secret"
     LICENSE_FILE = "license-file"

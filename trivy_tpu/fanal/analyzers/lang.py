@@ -36,7 +36,13 @@ _SPECS = [
     (AnalyzerType.PUB_SPEC, "pubspec-lock", lambda n: n == "pubspec.lock", P.parse_pubspec_lock),
     (AnalyzerType.COCOAPODS, "cocoapods", lambda n: n == "Podfile.lock", P.parse_podfile_lock),
     (AnalyzerType.SWIFT, "swift", lambda n: n == "Package.resolved", P.parse_swift_resolved),
-    (AnalyzerType.JULIA, "julia", lambda n: n == "Manifest.toml", None),  # placeholder
+    (AnalyzerType.JULIA, "julia", lambda n: n == "Manifest.toml", P.parse_julia_manifest),
+    (AnalyzerType.DOTNET_DEPS, "dotnet-core", lambda n: n.endswith(".deps.json"), P.parse_dotnet_deps),
+    (AnalyzerType.SBT_LOCK, "sbt-lockfile", lambda n: n == "build.sbt.lock", P.parse_sbt_lock),
+    (AnalyzerType.CONDA_ENV, "conda-environment",
+     lambda n: n in ("environment.yml", "environment.yaml"), P.parse_conda_environment),
+    (AnalyzerType.PACKAGES_PROPS, "packages-props",
+     lambda n: n in ("Packages.props", "Directory.Packages.props"), P.parse_packages_props),
 ]
 
 

@@ -79,6 +79,11 @@ def parse_image_ref(ref: str) -> tuple[str, str, str]:
     ):
         registry = parts[0]
         repository = "/".join(parts[1:])
+        if registry in ("docker.io", "index.docker.io"):
+            # Docker Hub's v2 API host differs from its reference name
+            registry = "registry-1.docker.io"
+            if "/" not in repository:
+                repository = f"library/{repository}"
     else:
         registry = "registry-1.docker.io"
         repository = name if "/" in name else f"library/{name}"
@@ -110,8 +115,8 @@ class RegistryClient:
         raw = f"{self.username}:{self.password}".encode()
         return "Basic " + base64.b64encode(raw).decode()
 
-    def _request(self, path: str, accept: str = "") -> tuple[bytes, dict]:
-        """GET with one token-challenge retry; returns (body, headers)."""
+    def _open(self, path: str, accept: str = ""):
+        """GET with one token-challenge retry; returns the open response."""
         for attempt in (0, 1):
             req = urllib.request.Request(self._url(path))
             if accept:
@@ -121,8 +126,7 @@ class RegistryClient:
             elif self.username:
                 req.add_header("Authorization", self._basic_header())
             try:
-                with _OPENER.open(req, timeout=30) as resp:
-                    return resp.read(), dict(resp.headers)
+                return _OPENER.open(req, timeout=30)
             except urllib.error.HTTPError as e:
                 if e.code in (301, 302, 303, 307, 308):
                     # follow manually WITHOUT auth headers: presigned CDN
@@ -131,10 +135,9 @@ class RegistryClient:
                     loc = e.headers.get("Location", "")
                     if loc:
                         try:
-                            with urllib.request.urlopen(
+                            return urllib.request.urlopen(
                                 urllib.request.Request(loc), timeout=60
-                            ) as r2:
-                                return r2.read(), dict(r2.headers)
+                            )
                         except urllib.error.URLError as e2:
                             raise RegistryError(
                                 f"redirected blob fetch failed: {e2}"
@@ -152,6 +155,10 @@ class RegistryClient:
                     f"cannot reach registry {self.registry}: {e.reason}"
                 ) from e
         raise RegistryError(f"authorization failed for {path}")
+
+    def _request(self, path: str, accept: str = "") -> tuple[bytes, dict]:
+        with self._open(path, accept) as resp:
+            return resp.read(), dict(resp.headers)
 
     def _fetch_token(self, challenge: str) -> None:
         """Bearer challenge -> token endpoint round trip
@@ -205,6 +212,32 @@ class RegistryClient:
                 )
         return body
 
+    def blob_file(self, repository: str, digest: str):
+        """Blob streamed to a spooled temp file (memory-bounded: multi-GB
+        layers never sit fully in RAM), hash-verified, seeked to 0."""
+        import tempfile
+
+        resp = self._open(f"/v2/{repository}/blobs/{digest}")
+        h = hashlib.sha256()
+        spool = tempfile.SpooledTemporaryFile(max_size=32 * 1024 * 1024)
+        try:
+            while True:
+                chunk = resp.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+                spool.write(chunk)
+        finally:
+            resp.close()
+        algo, _, hexd = digest.partition(":")
+        if algo == "sha256" and h.hexdigest() != hexd:
+            spool.close()
+            raise RegistryError(
+                f"blob digest mismatch: want {hexd}, got {h.hexdigest()}"
+            )
+        spool.seek(0)
+        return spool
+
 
 class RegistryImage:
     """Image pulled from a registry, presenting the archive-source surface
@@ -228,7 +261,13 @@ class RegistryImage:
         manifest = self.client.manifest(repository, reference)
         # image index: pick the requested platform, else the first image
         while "manifests" in manifest:
-            entries = manifest["manifests"]
+            # attestation/unknown entries are not runnable images
+            entries = [
+                e for e in manifest["manifests"]
+                if (e.get("platform") or {}).get("os") != "unknown"
+            ] or manifest["manifests"]
+            if not entries:
+                raise RegistryError(f"image index for {ref} lists no manifests")
             chosen = None
             if platform:
                 want_os, _, want_arch = platform.partition("/")
@@ -239,6 +278,16 @@ class RegistryImage:
                     ):
                         chosen = e
                         break
+                if chosen is None:
+                    avail = ", ".join(
+                        f"{(e.get('platform') or {}).get('os', '?')}/"
+                        f"{(e.get('platform') or {}).get('architecture', '?')}"
+                        for e in entries
+                    )
+                    raise RegistryError(
+                        f"no {platform} image in index for {ref} "
+                        f"(available: {avail})"
+                    )
             if chosen is None:
                 chosen = entries[0]
             manifest = self.client.manifest(repository, chosen["digest"])
@@ -263,15 +312,15 @@ class RegistryImage:
     def layer_stream(self, index: int):
         desc = self._layers[index]
         mt = desc.get("mediaType", "")
-        raw = self.client.blob(self.repository, desc["digest"])
-        if mt.endswith(("gzip", "gzip+encrypted")):
-            return gzip.GzipFile(fileobj=io.BytesIO(raw))
         if mt.endswith("zstd"):
             raise RegistryError(
                 f"layer {desc['digest']} uses zstd compression, which this "
                 "build cannot decompress; re-push the image with gzip layers"
             )
-        return io.BytesIO(raw)
+        spool = self.client.blob_file(self.repository, desc["digest"])
+        if mt.endswith(("gzip", "gzip+encrypted")):
+            return gzip.GzipFile(fileobj=spool, mode="rb")
+        return spool
 
     def layer_history(self) -> list[dict]:
         return [

@@ -23,7 +23,8 @@ def _severity_summary(counter: Counter) -> str:
     return ", ".join(parts) if parts else "none"
 
 
-def write_table(report: Report, out, show_suppressed: bool = False, **_kw) -> None:
+def write_table(report: Report, out, show_suppressed: bool = False,
+                dependency_tree: bool = False, **_kw) -> None:
     visible = any(not r.is_empty for r in report.results)
     n_suppressed = sum(len(r.modified_findings) for r in report.results)
     if not visible:
@@ -36,8 +37,66 @@ def write_table(report: Report, out, show_suppressed: bool = False, **_kw) -> No
             )
     for result in report.results:
         _write_result(result, out)
+        if dependency_tree and result.vulnerabilities and result.packages:
+            _write_dependency_tree(result, out)
         if show_suppressed and result.modified_findings:
             _write_suppressed(result, out)
+
+
+def _write_dependency_tree(result: Result, out) -> None:
+    """Reversed dependency-origin tree for vulnerable packages (ref: the
+    table writer's --dependency-tree rendering over
+    pkg/dependency/relationship.go graphs): each vulnerable package is a
+    root; its children are the packages that depend on it, walking up to
+    the direct dependencies a user can actually bump."""
+    by_id = {p.id or f"{p.name}@{p.version}": p for p in result.packages}
+    reverse: dict[str, list[str]] = {}
+    for p in result.packages:
+        pid = p.id or f"{p.name}@{p.version}"
+        for dep in p.depends_on:
+            reverse.setdefault(dep, []).append(pid)
+    if not reverse:
+        return
+    from collections import Counter as _Counter
+
+    vuln_counts: dict[str, _Counter] = {}
+    for v in result.vulnerabilities:
+        pid = v.pkg_id or f"{v.pkg_name}@{v.installed_version}"
+        vuln_counts.setdefault(pid, _Counter())[v.severity] += 1
+    out.write("\nDependency Origin Tree (Reversed)\n")
+    out.write(_rule(40) + "\n")
+    out.write(f"{result.target}\n")
+    roots = sorted(vuln_counts)
+    for ri, pid in enumerate(roots):
+        last_root = ri == len(roots) - 1
+        counts = vuln_counts[pid]
+        summary = ", ".join(f"{s}: {c}" for s, c in sorted(counts.items()))
+        out.write(f"{'└── ' if last_root else '├── '}{pid}, ({summary})\n")
+        prefix = "    " if last_root else "│   "
+        # BFS up the reverse edges (cycle-guarded) to show who pulls it in
+        seen = {pid}
+        level = [pid]
+        depth = 0
+        while level and depth < 8:
+            parents = sorted({
+                par for node in level for par in reverse.get(node, [])
+                if par not in seen
+            })
+            if not parents:
+                break
+            seen.update(parents)
+            for pi, par in enumerate(parents):
+                last = pi == len(parents) - 1
+                rel = ""
+                pk = by_id.get(par)
+                if pk is not None and pk.relationship in ("direct", "root", "workspace"):
+                    rel = f" ({pk.relationship})"
+                out.write(
+                    prefix + "    " * depth
+                    + ("└── " if last else "├── ") + par + rel + "\n"
+                )
+            level = parents
+            depth += 1
 
 
 def _write_suppressed(result: Result, out) -> None:

@@ -61,6 +61,7 @@ def decode_cyclonedx(doc: dict) -> BlobInfo:
     apps: dict[str, Application] = {}
     os_pkgs: list[Package] = []
     distro = ""
+    pkg_by_ref: dict[str, Package] = {}
     for comp in doc.get("components", []) or []:
         ctype = comp.get("type", "")
         if ctype == "operating-system":
@@ -81,11 +82,25 @@ def decode_cyclonedx(doc: dict) -> BlobInfo:
             if isinstance(l, dict)
         ]
         pkg.licenses = [x for x in pkg.licenses if x]
+        pkg_by_ref[comp.get("bom-ref", "") or purl_str] = pkg
         if app_type.startswith("__os__:"):
             distro = distro or app_type.split(":", 1)[1]
             os_pkgs.append(pkg)
         else:
             apps.setdefault(app_type, Application(type=app_type)).packages.append(pkg)
+    # dependency graph round-trip: dependsOn refs -> package "name@version"
+    # IDs (ref: pkg/sbom/io/decode.go)
+    for dep in doc.get("dependencies", []) or []:
+        src = pkg_by_ref.get(dep.get("ref", ""))
+        if src is None:
+            continue
+        src.depends_on = sorted(
+            {
+                f"{t.name}@{t.version}"
+                for r in dep.get("dependsOn", []) or []
+                if (t := pkg_by_ref.get(r)) is not None
+            }
+        )
     if os_pkgs:
         from trivy_tpu.types import PackageInfo
 

@@ -72,12 +72,21 @@ def encode_cyclonedx(report: Report) -> dict:
             }
         )
     seen: set[str] = set()
+    # package-ID -> bom-ref, for dependsOn edge resolution (the lockfile
+    # edges use "name@version" IDs; ref: pkg/sbom/io/encode.go dependency
+    # graph encoding)
+    ref_by_id: dict[str, str] = {}
+    edges_by_ref: dict[str, list[str]] = {}
+    pending_edges: list[tuple[str, list[str]]] = []
     for result, app_type, pkg in _iter_packages(report):
         p = purl_mod.from_package(
             pkg, app_type, os_info if result.cls == "os-pkgs" else None
         )
         purl_str = p.to_string() if p else ""
         ref = purl_str or f"pkg:{app_type}/{pkg.name}@{pkg.version}"
+        ref_by_id[pkg.id or f"{pkg.name}@{pkg.version}"] = ref
+        if pkg.depends_on:
+            pending_edges.append((ref, list(pkg.depends_on)))
         if ref in seen:
             continue
         seen.add(ref)
@@ -93,6 +102,14 @@ def encode_cyclonedx(report: Report) -> dict:
         if pkg.licenses:
             comp["licenses"] = [{"license": {"name": l}} for l in pkg.licenses]
         components.append(comp)
+    for ref, dep_ids in pending_edges:
+        resolved = sorted(
+            {ref_by_id[d] for d in dep_ids if d in ref_by_id}
+        )
+        if resolved:
+            edges_by_ref[ref] = sorted(
+                set(edges_by_ref.get(ref, [])) | set(resolved)
+            )
     for result in report.results:
         for v in result.vulnerabilities:
             entry = vulns.setdefault(
@@ -135,6 +152,11 @@ def encode_cyclonedx(report: Report) -> dict:
         },
         "components": components,
     }
+    if edges_by_ref:
+        doc["dependencies"] = [
+            {"ref": ref, "dependsOn": deps}
+            for ref, deps in sorted(edges_by_ref.items())
+        ]
     if vulns:
         doc["vulnerabilities"] = [vulns[k] for k in sorted(vulns)]
     return doc
