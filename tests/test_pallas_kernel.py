@@ -43,7 +43,9 @@ def test_pallas_interpret_matches_xla():
     with mock.patch.object(match_pallas.pl, "pallas_call", interp):
         fp = match_pallas.build_match_fn_pallas(compiled, CL)
         rows = []
-        picked = sorted(SAMPLES.values())[:8]
+        from trivy_tpu.ops.match_pallas import BLOCK_ROWS
+
+        picked = (sorted(SAMPLES.values()) * 8)[:BLOCK_ROWS]
         # half embedded mid-chunk, half at file offset 0 — the offset-0 rows
         # exercise the word-boundary check at the row edge (a secret first in
         # a file must still hit; regression for the shifted-in-zeros bug)
