@@ -1,0 +1,102 @@
+"""In-process fake Docker-Engine daemon on a unix socket (the same
+zero-egress technique as tests/registrytest.py: the reference tests its
+daemon clients against a fake engine API, pkg/fanal/image/daemon tests).
+
+Serves the three endpoints the daemon source uses: ``/_ping``,
+``/images/{ref}/json`` and ``/images/{ref}/get`` (the docker-save stream).
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler
+from urllib.parse import unquote
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class FakeDockerDaemon:
+    """images: ref -> docker-save tar bytes."""
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.images: dict[str, bytes] = {}
+        self.requests: list[str] = []
+
+    def add_image(self, ref: str, save_tar: bytes) -> None:
+        self.images[ref] = save_tar
+
+    def start(self):
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # docker clients speak HTTP/1.1 to the engine socket
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                daemon.requests.append(self.path)
+                if self.path == "/_ping":
+                    body = b"OK"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.startswith("/images/") and self.path.endswith(
+                    "/json"
+                ):
+                    ref = unquote(self.path[len("/images/") : -len("/json")])
+                    tar = daemon.images.get(ref)
+                    if tar is None:
+                        self._not_found(ref)
+                        return
+                    body = json.dumps(
+                        {"Id": "sha256:" + "0" * 64, "RepoTags": [ref]}
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path.startswith("/images/") and self.path.endswith(
+                    "/get"
+                ):
+                    ref = unquote(self.path[len("/images/") : -len("/get")])
+                    tar = daemon.images.get(ref)
+                    if tar is None:
+                        self._not_found(ref)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-tar")
+                    self.send_header("Content-Length", str(len(tar)))
+                    self.end_headers()
+                    self.wfile.write(tar)
+                    return
+                self._not_found(self.path)
+
+            def _not_found(self, what: str):
+                body = json.dumps({"message": f"no such image: {what}"}).encode()
+                self.send_response(404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._server = _UnixHTTPServer(self.socket_path, Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

@@ -319,3 +319,100 @@ def test_new_analyzers_wired():
             except Exception:
                 pass
     assert set(names) <= covered, covered
+
+
+def test_gomod_root_and_direct_edges():
+    mod = b"""module github.com/example/app
+
+go 1.21
+
+require (
+\tgithub.com/gin-gonic/gin v1.9.1
+\tgolang.org/x/crypto v0.14.0 // indirect
+)
+
+require github.com/stretchr/testify v1.8.4
+"""
+    pkgs = parsers.parse_gomod(mod)
+    root = pkgs[0]
+    assert root.name == "github.com/example/app"
+    assert root.relationship == "root"
+    assert root.depends_on == [
+        "github.com/gin-gonic/gin@1.9.1",
+        "github.com/stretchr/testify@1.8.4",
+    ]
+    rel = {p.name: p.relationship for p in pkgs[1:]}
+    assert rel["golang.org/x/crypto"] == "indirect"
+
+
+def test_nuget_lock_edges():
+    lock = json.dumps({
+        "version": 1,
+        "dependencies": {
+            "net6.0": {
+                "Newtonsoft.Json": {
+                    "type": "Direct",
+                    "resolved": "13.0.1",
+                    "dependencies": {"newtonsoft.json.bson": "1.0.2"},
+                },
+                "Newtonsoft.Json.Bson": {
+                    "type": "Transitive",
+                    "resolved": "1.0.2",
+                },
+            }
+        },
+    }).encode()
+    pkgs = by_id(parsers.parse_nuget_lock(lock))
+    assert pkgs["Newtonsoft.Json@13.0.1"].depends_on == [
+        "Newtonsoft.Json.Bson@1.0.2"
+    ]
+    assert pkgs["Newtonsoft.Json.Bson@1.0.2"].indirect
+
+
+def test_conan_v1_graph_edges():
+    lock = json.dumps({
+        "graph_lock": {
+            "nodes": {
+                "0": {"ref": None},
+                "1": {"ref": "openssl/3.0.8#abc", "requires": ["2"]},
+                "2": {"ref": "zlib/1.2.13#def"},
+            }
+        }
+    }).encode()
+    pkgs = by_id(parsers.parse_conan_lock(lock))
+    assert pkgs["openssl@3.0.8"].depends_on == ["zlib@1.2.13"]
+
+
+def test_mix_lock_edges():
+    lock = b'''%{
+  "phoenix": {:hex, :phoenix, "1.7.10", "HASH", [:mix], [{:plug, "~> 1.14", [hex: :plug, repo: "hexpm", optional: false]}, {:jason, "~> 1.0", [hex: :jason, repo: "hexpm", optional: true]}], "hexpm", "OUTER"},
+  "plug": {:hex, :plug, "1.15.2", "HASH", [:mix], [], "hexpm", "OUTER"},
+  "jason": {:hex, :jason, "1.4.1", "HASH", [:mix], [], "hexpm", "OUTER"},
+}
+'''
+    pkgs = by_id(parsers.parse_mix_lock(lock))
+    assert pkgs["phoenix@1.7.10"].depends_on == ["jason@1.4.1", "plug@1.15.2"]
+
+
+def test_pom_root_edges(tmp_path):
+    from trivy_tpu.dependency.pom import Resolver, fs_loader
+
+    pom = b"""<project>
+  <groupId>com.example</groupId>
+  <artifactId>app</artifactId>
+  <version>2.0.0</version>
+  <dependencies>
+    <dependency>
+      <groupId>com.fasterxml.jackson.core</groupId>
+      <artifactId>jackson-databind</artifactId>
+      <version>2.15.2</version>
+    </dependency>
+  </dependencies>
+</project>"""
+    pkgs = Resolver(fs_loader).resolve(pom, str(tmp_path / "pom.xml"))
+    root = pkgs[0]
+    assert root.relationship == "root"
+    assert root.name == "com.example:app"
+    assert root.depends_on == [
+        "com.fasterxml.jackson.core:jackson-databind@2.15.2"
+    ]

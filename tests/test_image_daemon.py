@@ -1,0 +1,148 @@
+"""Daemon image sources (docker/podman) against an in-process fake engine
+(ref: pkg/fanal/image/image.go:27-58 resolution order, daemon clients in
+pkg/fanal/image/daemon/)."""
+
+import io
+import os
+
+import pytest
+
+from tests.daemontest import FakeDockerDaemon
+from tests.imagetest import docker_save_tar, tar_bytes
+
+GHP = "ghp_" + "A1b2C3d4E5f6G7h8I9j0K1l2M3n4O5p6Q7r8"
+
+
+def _save_tar_bytes(tmp_path, ref="fixture:latest"):
+    layer = tar_bytes({
+        "etc/os-release": b'ID=alpine\nVERSION_ID=3.18.4\n',
+        "app/cred.txt": f"token {GHP}\n".encode(),
+    })
+    p = tmp_path / "img.tar"
+    docker_save_tar(str(p), [layer], repo_tag=ref)
+    return p.read_bytes()
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    sock = str(tmp_path / "docker.sock")
+    d = FakeDockerDaemon(sock).start()
+    d.add_image("alpine:3.18", _save_tar_bytes(tmp_path, "alpine:3.18"))
+    yield d
+    d.stop()
+
+
+def _scan(target, cache_dir, option):
+    from trivy_tpu.artifact.image import new_image_artifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    cache = new_cache("fs", str(cache_dir))
+    artifact = new_image_artifact(target, cache, option)
+    driver = LocalDriver(cache)
+    return Scanner(artifact, driver).scan_artifact(
+        ScanOptions(scanners=["secret"])
+    )
+
+
+def _opt(**kw):
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+
+    return ArtifactOption(backend="cpu", **kw)
+
+
+def test_docker_daemon_scan(daemon, tmp_path):
+    report = _scan(
+        "alpine:3.18", tmp_path / "cache",
+        _opt(docker_host=daemon.socket_path),
+    )
+    assert report.artifact_name == "alpine:3.18"
+    assert any(getattr(r, "secrets", []) for r in report.results), report
+    # the daemon served both inspect and export
+    assert any(p.endswith("/json") for p in daemon.requests)
+    assert any(p.endswith("/get") for p in daemon.requests)
+
+
+def test_docker_prefix_forces_daemon(daemon, tmp_path):
+    report = _scan(
+        "docker://alpine:3.18", tmp_path / "cache",
+        _opt(docker_host=daemon.socket_path),
+    )
+    assert report.artifact_name == "alpine:3.18"
+
+
+def test_docker_prefix_missing_image_errors(daemon, tmp_path):
+    from trivy_tpu.fanal.image_daemon import DaemonError
+
+    with pytest.raises(DaemonError):
+        _scan(
+            "docker://nosuch:latest", tmp_path / "cache",
+            _opt(docker_host=daemon.socket_path),
+        )
+
+
+def test_no_daemon_clean_error_without_remote(tmp_path):
+    from trivy_tpu.fanal.image_daemon import DaemonError
+
+    with pytest.raises(DaemonError):
+        _scan(
+            "alpine:3.18", tmp_path / "cache",
+            _opt(
+                docker_host=str(tmp_path / "absent.sock"),
+                image_src=["docker", "podman"],
+            ),
+        )
+
+
+def test_podman_socket_resolution(daemon, tmp_path):
+    report = _scan(
+        "alpine:3.18", tmp_path / "cache",
+        _opt(image_src=["podman"], podman_host=daemon.socket_path),
+    )
+    assert report.artifact_name == "alpine:3.18"
+
+
+def test_resolution_order_prefers_docker_over_remote(daemon, tmp_path):
+    # docker socket present and holds the image: no registry involved
+    report = _scan(
+        "alpine:3.18", tmp_path / "cache",
+        _opt(docker_host=daemon.socket_path,
+             image_src=["docker", "remote"]),
+    )
+    assert report.artifact_name == "alpine:3.18"
+
+
+def test_containerd_detected_with_clear_error(tmp_path):
+    from trivy_tpu.fanal.image_daemon import (
+        ContainerdSource,
+        DaemonError,
+        resolve_daemon_source,
+    )
+
+    sock = tmp_path / "containerd.sock"
+    sock.touch()
+
+    class Opt:
+        containerd_host = str(sock)
+        docker_host = ""
+        podman_host = ""
+
+    src = resolve_daemon_source("x:1", ["containerd"], Opt())
+    assert isinstance(src, ContainerdSource)
+    with pytest.raises(DaemonError, match="ctr images export"):
+        src.export_to(str(tmp_path / "out.tar"))
+
+
+def test_temp_archive_cleaned_up(daemon, tmp_path):
+    from trivy_tpu.artifact.image import new_image_artifact
+    from trivy_tpu.cache import new_cache
+
+    cache = new_cache("fs", str(tmp_path / "cache"))
+    art = new_image_artifact(
+        "alpine:3.18", cache, _opt(docker_host=daemon.socket_path)
+    )
+    tmp = art._tmp
+    assert os.path.exists(tmp)
+    art.close()
+    assert not os.path.exists(tmp)

@@ -387,9 +387,68 @@ class ImageRegistryArtifact(ImageArchiveArtifact):
         return cached
 
 
+class DaemonImageArtifact(ImageArchiveArtifact):
+    """Image exported from a runtime daemon (docker/podman), then scanned
+    through the archive pipeline — the daemon is only a byte source, like
+    the reference's daemon clients feeding the same layer walk
+    (pkg/fanal/image/daemon/)."""
+
+    def __init__(self, ref: str, source, cache, option=None):
+        from trivy_tpu.fanal.image_daemon import export_to_tempfile
+
+        self._tmp = export_to_tempfile(source)
+        self.ref = ref
+        try:
+            super().__init__(self._tmp, cache, option)
+        except BaseException:
+            self.close()
+            raise
+        self.path = ref  # report target name stays the user's reference
+
+    def _open_source(self):
+        return _ImageArchive(self._tmp)
+
+    def close(self) -> None:
+        if getattr(self, "_tmp", None) and os.path.exists(self._tmp):
+            os.unlink(self._tmp)
+            self._tmp = ""
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 def new_image_artifact(target: str, cache, option: ArtifactOption | None = None):
-    """Archive path when it exists on disk, else a registry reference —
-    the resolution-order analog of pkg/fanal/image/image.go:27-58."""
+    """Archive path when it exists on disk, else daemon sources in
+    ``--image-src`` order, else a registry reference — the resolution-order
+    analog of pkg/fanal/image/image.go:27-58."""
+    from trivy_tpu.fanal.image_daemon import resolve_daemon_source
+
     if os.path.exists(target):
         return ImageArchiveArtifact(target, cache, option)
-    return ImageRegistryArtifact(target, cache, option)
+    default_src = ArtifactOption().image_src
+    image_src = list(getattr(option, "image_src", None) or default_src)
+    ref = target
+    # explicit source prefix, skopeo-style ``docker://ref`` — the bare
+    # ``docker:tag`` form stays a registry reference (the Docker-Hub image
+    # named "docker" is a real target)
+    for src in ("docker", "podman", "containerd"):
+        if target.startswith(src + "://"):
+            image_src = [src]
+            ref = target[len(src) + 3 :]
+            break
+    source = resolve_daemon_source(ref, image_src, option)
+    if source is not None:
+        return DaemonImageArtifact(ref, source, cache, option)
+    if "remote" not in image_src:
+        # an explicit daemon prefix / restricted --image-src must not
+        # silently fall through to the registry
+        from trivy_tpu.fanal.image_daemon import DaemonError
+
+        raise DaemonError(
+            f"image {ref!r} not found via {image_src} (daemon socket "
+            "missing or image absent) and 'remote' is not enabled"
+        )
+    return ImageRegistryArtifact(ref, cache, option)

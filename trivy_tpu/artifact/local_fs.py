@@ -40,6 +40,14 @@ class ArtifactOption:
     registry_username: str = ""
     registry_password: str = ""
     platform: str = ""
+    # daemon image source options (--image-src resolution order, ref:
+    # pkg/fanal/image/image.go:27-58)
+    image_src: list[str] = field(
+        default_factory=lambda: ["docker", "containerd", "podman", "remote"]
+    )
+    docker_host: str = ""
+    podman_host: str = ""
+    containerd_host: str = ""
 
 
 class LocalFSArtifact:

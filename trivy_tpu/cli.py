@@ -163,6 +163,17 @@ def image_flags() -> FlagGroup:
                  help="registry basic-auth password"),
             Flag("platform", default=None, config_name="image.platform",
                  help="platform for multi-arch images (os/arch)"),
+            Flag("image-src", default=None, is_list=True,
+                 config_name="image.source",
+                 help="image source resolution order "
+                      "(docker,containerd,podman,remote)"),
+            Flag("docker-host", default=None, config_name="image.docker.host",
+                 help="docker daemon socket/host (unix path or tcp:// URL)"),
+            Flag("podman-host", default=None, config_name="image.podman.host",
+                 help="podman service socket"),
+            Flag("containerd-host", default=None,
+                 config_name="image.containerd.host",
+                 help="containerd socket path"),
         ],
     )
 

@@ -64,6 +64,14 @@ def _artifact_option(ns, opts):
         registry_username=opts.get("username", "") or "",
         registry_password=opts.get("password", "") or "",
         platform=opts.get("platform", "") or "",
+        docker_host=opts.get("docker_host", "") or "",
+        podman_host=opts.get("podman_host", "") or "",
+        containerd_host=opts.get("containerd_host", "") or "",
+        **(
+            {"image_src": list(opts.get("image_src"))}
+            if opts.get("image_src")
+            else {}  # unset flag -> the ArtifactOption default order
+        ),
     )
 
 

@@ -19,14 +19,27 @@ def _pkg(name: str, version: str, **kw) -> Package:
 # --- go.mod (ref: parser/golang/mod) ---------------------------------------
 
 _GOMOD_REQ = re.compile(r"^\s*(?P<mod>\S+)\s+(?P<ver>v\S+?)(?:\s*//\s*(?P<c>.*))?$")
+_GOMOD_MODULE = re.compile(r"^\s*module\s+(\S+)")
 
 
 def parse_gomod(content: bytes, path: str = "") -> list[Package]:
+    """go.mod requires with direct/indirect split and a root module node.
+
+    go.mod carries no inter-module edges (the build list is flattened since
+    Go 1.17), so the graph the reference renders is root -> direct requires
+    (ref: parser/golang/mod marks the main module Relationship root); the
+    indirect set stays flat, exactly as much as the file encodes.
+    """
     pkgs: list[Package] = []
+    module = ""
     in_require = False
     for raw in content.decode("utf-8", "replace").splitlines():
         line = raw.split("//", 1)[0].rstrip() if "// indirect" not in raw else raw.rstrip()
         s = line.strip()
+        mm = _GOMOD_MODULE.match(s)
+        if mm and not module:
+            module = mm.group(1)
+            continue
         if s.startswith("require ("):
             in_require = True
             continue
@@ -48,6 +61,13 @@ def parse_gomod(content: bytes, path: str = "") -> list[Package]:
                     relationship="indirect" if indirect else "direct",
                 )
             )
+    if module and pkgs:
+        root = Package(name=module, version="", relationship="root")
+        root.id = module
+        root.depends_on = sorted(
+            p.id for p in pkgs if p.relationship == "direct"
+        )
+        pkgs.insert(0, root)
     return pkgs
 
 
@@ -434,6 +454,9 @@ def parse_composer_lock(content: bytes, path: str = "") -> list[Package]:
 
 
 def parse_gradle_lock(content: bytes, path: str = "") -> list[Package]:
+    # gradle.lockfile records `group:artifact:version=configurations` lines
+    # only — no inter-dependency edges exist in the format (the reference's
+    # parser/gradle/lockfile is likewise flat), so no graph is synthesized
     pkgs = []
     for line in content.decode("utf-8", "replace").splitlines():
         line = line.strip()
@@ -450,15 +473,34 @@ def parse_gradle_lock(content: bytes, path: str = "") -> list[Package]:
 
 
 def parse_nuget_lock(content: bytes, path: str = "") -> list[Package]:
+    """packages.lock.json incl. the per-package dependency edges it records
+    (each entry's ``dependencies`` maps name -> requested range; resolved
+    versions come from the entries themselves — ref: parser/nuget/lock)."""
     doc = json.loads(content)
     out: dict[tuple[str, str], Package] = {}
     for _fw, deps in (doc.get("dependencies") or {}).items():
+        # resolution is per target framework: edges must bind to the
+        # version THIS framework resolved, not first-framework-wins
+        resolved: dict[str, str] = {}  # name(lower) -> id
+        raw_deps: dict[tuple[str, str], list[str]] = {}
         for name, meta in (deps or {}).items():
             ver = (meta or {}).get("resolved", "")
-            if ver:
-                out.setdefault(
-                    (name, ver),
-                    _pkg(name, ver, indirect=(meta.get("type") == "Transitive")),
+            if not ver:
+                continue
+            out.setdefault(
+                (name, ver),
+                _pkg(name, ver, indirect=(meta.get("type") == "Transitive")),
+            )
+            resolved[name.lower()] = f"{name}@{ver}"
+            names = sorted((meta.get("dependencies") or {}).keys())
+            if names:
+                raw_deps[(name, ver)] = names
+        for key, names in raw_deps.items():
+            # NuGet ids are case-insensitive: edges use the entry's spelling
+            edges = [resolved[n.lower()] for n in names if n.lower() in resolved]
+            if edges:
+                out[key].depends_on = sorted(
+                    set(out[key].depends_on) | set(edges)
                 )
     return [out[k] for k in sorted(out)]
 
@@ -488,31 +530,64 @@ def parse_conan_lock(content: bytes, path: str = "") -> list[Package]:
     doc = json.loads(content)
     pkgs = []
     reqs = doc.get("requires") or []
-    if isinstance(reqs, list):  # v2 lockfile
+    if isinstance(reqs, list):  # v2 lockfile (flat: no graph recorded)
         for r in reqs:
             ref = r.split("#", 1)[0]
             if "/" in ref:
                 name, _, ver = ref.partition("/")
                 pkgs.append(_pkg(name, ver.split("@", 1)[0]))
+    # v1 lockfile: graph_lock carries real edges (node "requires" lists)
     nodes = (doc.get("graph_lock") or {}).get("nodes") or {}
-    for _nid, node in nodes.items():  # v1 lockfile
-        ref = (node or {}).get("ref", "")
-        ref = ref.split("#", 1)[0]
+    by_nid: dict[str, Package] = {}
+    for nid, node in nodes.items():
+        ref = ((node or {}).get("ref") or "").split("#", 1)[0]
         if "/" in ref:
             name, _, ver = ref.partition("/")
-            pkgs.append(_pkg(name, ver.split("@", 1)[0]))
+            p = _pkg(name, ver.split("@", 1)[0])
+            by_nid[nid] = p
+            pkgs.append(p)
+    for nid, node in nodes.items():
+        if nid not in by_nid:
+            continue
+        edges = [
+            by_nid[r].id
+            for r in (node or {}).get("requires") or []
+            if r in by_nid
+        ]
+        if edges:
+            by_nid[nid].depends_on = sorted(set(edges))
     return pkgs
 
 
 # --- mix.lock (ref: parser/hex/mix) -----------------------------------------
 
-_MIX_RE = re.compile(r'"(?P<name>[^"]+)":\s*\{:hex,\s*:(?P<pkg>\w+),\s*"(?P<ver>[^"]+)"')
+_MIX_RE = re.compile(
+    r'"(?P<name>[^"]+)":\s*\{:hex,\s*:(?P<pkg>\w+),\s*"(?P<ver>[^"]+)"'
+    r'(?P<rest>[^\n]*)'
+)
+_MIX_DEP_RE = re.compile(r"\{:(?P<dep>\w+),")
 
 
 def parse_mix_lock(content: bytes, path: str = "") -> list[Package]:
+    """mix.lock entries incl. edges: each hex tuple's 6th element lists the
+    package's own deps as `{:name, requirement, [hex: :name, ...]}` tuples
+    (one entry per line in mix's output format — ref: parser/hex/mix)."""
+    text = content.decode("utf-8", "replace")
+    entries = []
+    for m in _MIX_RE.finditer(text):
+        entries.append((m.group("name"), m.group("ver"), m.group("rest")))
+    by_name = {name: f"{name}@{ver}" for name, ver, _ in entries}
     pkgs = []
-    for m in _MIX_RE.finditer(content.decode("utf-8", "replace")):
-        pkgs.append(_pkg(m.group("name"), m.group("ver")))
+    for name, ver, rest in entries:
+        p = _pkg(name, ver)
+        edges = {
+            by_name[d.group("dep")]
+            for d in _MIX_DEP_RE.finditer(rest)
+            if d.group("dep") in by_name and d.group("dep") != name
+        }
+        if edges:
+            p.depends_on = sorted(edges)
+        pkgs.append(p)
     return pkgs
 
 

@@ -164,6 +164,27 @@ class Resolver:
                     identifier=PkgIdentifier(purl=f"pkg:maven/{g}/{a}@{v}"),
                 )
         out = sorted(pkgs.values(), key=lambda p: (p.name, p.version))
+        for p in out:
+            p.id = p.id or f"{p.name}@{p.version}"
+            p.relationship = "direct"
+        # root node: the pom's own GAV with edges to every resolved direct
+        # dependency (the offline-derivable slice of the reference's module
+        # graph, pkg/dependency/parser/java/pom + relationship.go)
+        g = interp(child.group) or props.get("project.groupId", "")
+        a = child.artifact
+        v = interp(child.version)
+        if a and out:
+            root = Package(
+                name=f"{g}:{a}" if g else a,
+                version=v,
+                relationship="root",
+                identifier=PkgIdentifier(
+                    purl=f"pkg:maven/{g}/{a}@{v}" if g and v else ""
+                ),
+            )
+            root.id = f"{root.name}@{v}" if v else root.name
+            root.depends_on = sorted(p.id for p in out)
+            out.insert(0, root)
         return out
 
     def _add_mgmt(self, dep_mgmt: dict, d: dict, interp, pom_path: str) -> None:

@@ -22,9 +22,14 @@ its own pallas_call over the same input and the per-rule partials OR together
 in XLA. Re-reading the input per group costs only G× HBM input traffic,
 negligible next to the VPU work.
 
-Mosaic constraints honored here: vector arithmetic is i32/i16 only (bytes
-widen on entry), and i1 vectors can't be stored/concatenated (all masks are
-int32 0/1 planes combined with bitwise ops).
+Mask planes are int16 0/1 (packed (16, 128) tiling holds 2x the values per
+vreg vs i32, halving both the VPU op count and the VMEM working set of the
+bitwise/shift passes). This target's VPU compares only 32-bit lanes, so
+byte *compares* run on one widened i32 plane and everything downstream
+(levels, windows, boundaries, column folds) stays int16 and strictly
+bitwise: masks are 0/1, so negation is xor and max is or — Mosaic supports
+no narrow-int arithmetic. i1 vectors can't be stored/concatenated, so
+predicates widen to int16 on creation.
 """
 
 from __future__ import annotations
@@ -51,6 +56,8 @@ GROUP_MASK_BUDGET = 48
 # batching bounds the keyword kernel's VMEM stack the same way the mask
 # budget bounds the anchored groups
 KEYWORD_BATCH = 72
+# mask-plane dtype: the narrowest integer the target VPU can compare
+MDT = jnp.int16
 
 
 def _class_intervals(compiled: CompiledRules):
@@ -115,10 +122,14 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
 
     def make_kernel(group, keywords=()):
         def kernel(x_ref, out_ref):
-            x = x_ref[:].astype(jnp.int32)  # [TB, Cp] zero-padded rows
+            # this target's VPU compares only 32-bit lanes (Mosaic rejects
+            # cmpi on packed i8/i16 vectors), but bitwise ops run on packed
+            # i16 at 2x the values per vreg: so *compare* on the widened i32
+            # plane and *store/combine* every mask as int16
+            xb = x_ref[:].astype(jnp.int32)  # [TB, Cp] zero-padded rows
 
             def b(pred):
-                return pred.astype(jnp.int32)
+                return pred.astype(MDT)
 
             def shift(arr, d):
                 """Plane values at chunk positions p+d — a static slice of
@@ -139,11 +150,12 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                 i32 — shared by every literal in the kernel, so an L-byte
                 literal costs ~L/4 plane compares instead of L."""
                 if key not in packed_cache:
+                    d32 = data.astype(jnp.int32)
                     packed_cache[key] = (
-                        (data << 24)
-                        | (roll(data, 1) << 16)
-                        | (roll(data, 2) << 8)
-                        | roll(data, 3)
+                        (d32 << 24)
+                        | (roll(d32, 1) << 16)
+                        | (roll(d32, 2) << 8)
+                        | roll(d32, 3)
                     )
                 return packed_cache[key]
 
@@ -175,11 +187,16 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                 kind, ivs = class_intervals[cid]
                 m = None
                 for lo, hi in ivs:
-                    t = b(x == lo) if lo == hi else b(x >= lo) & b(x <= hi)
+                    if lo == hi:
+                        t = b(xb == lo)
+                    else:
+                        t = b(xb >= lo) & b(xb <= hi)
                     m = t if m is None else (m | t)
                 if m is None:
-                    m = jnp.zeros(x.shape, dtype=jnp.int32)
-                return 1 - m if kind == "neg" else m
+                    m = jnp.zeros(xb.shape, dtype=MDT)
+                # masks are 0/1: negation is xor, max is or (keeps every
+                # plane op bitwise — no narrow-int arithmetic for Mosaic)
+                return (m ^ MDT(1)) if kind == "neg" else m
 
             cache: dict[tuple[int, int], jax.Array] = {}
 
@@ -202,48 +219,72 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
                     hit &= shift(lv, delta + n - (1 << k))
                 return hit
 
+            def colmax(ok):
+                """Per-row any() as a narrow column: Mosaic has no narrow-int
+                reductions, so fold halves with | in the mask dtype (total
+                work ~1 plane) and widen only the final <=255-lane strip."""
+                while ok.shape[1] > 128 and (ok.shape[1] // 2) % 128 == 0:
+                    h = ok.shape[1] // 2
+                    ok = ok[:, :h] | ok[:, h:]
+                return jnp.max(
+                    ok.astype(jnp.int32), axis=1, keepdims=True
+                ).astype(MDT)
+
             na = None
             per_rule: dict[int, jax.Array] = {}
 
             for ridx, v in group:
-                ok = literal_hit(v.anchor, x)
+                ok = literal_hit(v.anchor, xb)
                 for ch in v.checks:
                     ok &= window_ok(ch.class_id, ch.count, ch.delta)
                 if v.boundary:
                     if na is None:
                         a = None
                         for lo, hi in _ALNUM_INTERVALS:
-                            t = b(x >= lo) & b(x <= hi)
+                            t = b(xb >= lo) & b(xb <= hi)
                             a = t if a is None else (a | t)
                         # non-alnum over the padded plane: padding zeros are
                         # non-alnum, so a secret at file/chunk offset 0
                         # passes the word-boundary check (match.py:173-177)
-                        na = 1 - a
+                        na = a ^ MDT(1)
                     ok &= shift(na, -v.pre_len - 1)
-                col = jnp.max(ok, axis=1, keepdims=True)
-                per_rule[ridx] = (
-                    jnp.maximum(per_rule[ridx], col) if ridx in per_rule else col
-                )
+                col = colmax(ok)
+                per_rule[ridx] = (per_rule[ridx] | col) if ridx in per_rule else col
 
             if keywords:
-                xl = jnp.where((x >= 65) & (x <= 90), x + 32, x)
+                # ASCII lowercase = set bit 5 on A-Z
+                is_up = (xb >= 65) & (xb <= 90)
+                xl = jnp.where(is_up, xb | 32, xb)
                 for ridx, kw in keywords:
                     ok = literal_hit(kw, xl, key=1)
-                    col = jnp.max(ok, axis=1, keepdims=True)
+                    col = colmax(ok)
                     per_rule[ridx] = (
-                        jnp.maximum(per_rule[ridx], col) if ridx in per_rule else col
+                        (per_rule[ridx] | col) if ridx in per_rule else col
                     )
 
-            zero = jnp.zeros((x.shape[0], 1), dtype=jnp.int32)
+            zero = jnp.zeros((xb.shape[0], 1), dtype=MDT)
             cols = [per_rule.get(r, zero) for r in range(R)]
             out_ref[:] = jnp.concatenate(cols, axis=1)
 
         return kernel
 
-    kernels = [make_kernel(g) for g in var_groups]
+    # fold the keyword pass into the anchored-group kernels (shares the input
+    # load and the per-kernel dispatch overhead); only the overflow past
+    # KEYWORD_BATCH per kernel gets keyword-only kernels
     kws = list(compiled.keywords)
-    for i in range(0, len(kws), KEYWORD_BATCH):
-        kernels.append(make_kernel([], keywords=tuple(kws[i : i + KEYWORD_BATCH])))
+    kw_slices: list[tuple] = []
+    if var_groups:
+        per = min(KEYWORD_BATCH, -(-len(kws) // len(var_groups)))
+        kw_slices = [tuple(kws[i : i + per]) for i in range(0, len(kws), per)]
+    kernels = [
+        make_kernel(g, kw_slices[i] if i < len(kw_slices) else ())
+        for i, g in enumerate(var_groups)
+    ]
+    for sl in kw_slices[len(var_groups) :]:
+        kernels.append(make_kernel([], keywords=sl))
+    if not var_groups:
+        for i in range(0, len(kws), KEYWORD_BATCH):
+            kernels.append(make_kernel([], keywords=tuple(kws[i : i + KEYWORD_BATCH])))
     if not kernels:
         # every rule is host-lane: nothing to check on device
         @jax.jit
@@ -262,7 +303,7 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
             partials.append(
                 pl.pallas_call(
                     kern,
-                    out_shape=jax.ShapeDtypeStruct((B, R), jnp.int32),
+                    out_shape=jax.ShapeDtypeStruct((B, R), MDT),
                     grid=(B // BLOCK_ROWS,),
                     in_specs=[
                         pl.BlockSpec(
