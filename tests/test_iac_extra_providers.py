@@ -1,0 +1,180 @@
+"""Long-tail IaC providers: digitalocean/openstack/oracle/cloudstack/
+nifcloud terraform scanning (ref: pkg/iac/providers/*,
+pkg/iac/adapters/terraform/*)."""
+
+import pytest
+
+from trivy_tpu.misconf.scanner import MisconfScanner, ScannerOption
+
+
+def scan_tf(hcl: str):
+    scanner = MisconfScanner(ScannerOption())
+    out = scanner.scan_files([("main.tf", hcl.encode())])
+    fails = {f.id for mc in out for f in mc.failures}
+    return fails, out
+
+
+def test_digitalocean_firewall_droplet_spaces():
+    fails, _ = scan_tf('''
+resource "digitalocean_firewall" "web" {
+  name = "web"
+  inbound_rule {
+    protocol         = "tcp"
+    port_range       = "22"
+    source_addresses = ["0.0.0.0/0", "::/0"]
+  }
+  outbound_rule {
+    protocol              = "tcp"
+    port_range            = "443"
+    destination_addresses = ["10.0.0.0/8"]
+  }
+}
+
+resource "digitalocean_droplet" "worker" {
+  image = "ubuntu-22-04-x64"
+}
+
+resource "digitalocean_spaces_bucket" "assets" {
+  name = "assets"
+  acl  = "public-read"
+}
+''')
+    assert "AVD-DIG-0001" in fails     # public ingress
+    assert "AVD-DIG-0002" not in fails  # restricted egress
+    assert "AVD-DIG-0004" in fails     # droplet without ssh keys
+    assert "AVD-DIG-0006" in fails     # public-read spaces acl
+    assert "AVD-DIG-0007" in fails     # no versioning
+
+
+def test_digitalocean_lb_and_k8s():
+    fails, _ = scan_tf('''
+resource "digitalocean_loadbalancer" "pub" {
+  name = "pub"
+  forwarding_rule {
+    entry_protocol  = "http"
+    entry_port      = 80
+    target_protocol = "http"
+    target_port     = 80
+  }
+}
+
+resource "digitalocean_kubernetes_cluster" "main" {
+  name          = "main"
+  surge_upgrade = true
+  auto_upgrade  = true
+}
+''')
+    assert "AVD-DIG-0008" in fails
+    assert "AVD-DIG-0009" not in fails
+    assert "AVD-DIG-0010" not in fails
+
+
+def test_openstack_checks():
+    fails, _ = scan_tf('''
+resource "openstack_compute_instance_v2" "box" {
+  name       = "box"
+  admin_pass = "N0tSoSecret!"
+}
+
+resource "openstack_networking_secgroup_v2" "sg" {
+  name = "sg"
+}
+
+resource "openstack_networking_secgroup_rule_v2" "open" {
+  direction        = "ingress"
+  remote_ip_prefix = "0.0.0.0/0"
+}
+''')
+    assert {"AVD-OPNSTK-0001", "AVD-OPNSTK-0003", "AVD-OPNSTK-0004"} <= fails
+    assert "AVD-OPNSTK-0005" not in fails
+
+
+def test_oracle_public_ip_pool():
+    fails, _ = scan_tf('''
+resource "opc_compute_ip_address_reservation" "rsv" {
+  name            = "rsv"
+  ip_address_pool = "public-ippool"
+}
+''')
+    assert "AVD-ORCL-0001" in fails
+
+
+def test_cloudstack_sensitive_user_data():
+    fails, _ = scan_tf('''
+resource "cloudstack_instance" "web" {
+  name      = "web"
+  user_data = "export DATABASE_PASSWORD=changeme"
+}
+''')
+    assert "AVD-CLDSTK-0001" in fails
+    ok, _ = scan_tf('''
+resource "cloudstack_instance" "web" {
+  name      = "web"
+  user_data = "echo hello"
+}
+''')
+    assert "AVD-CLDSTK-0001" not in ok
+
+
+def test_nifcloud_security_groups_and_rdb():
+    fails, _ = scan_tf('''
+resource "nifcloud_security_group" "web" {
+  group_name = "web"
+}
+
+resource "nifcloud_security_group_rule" "in_any" {
+  security_group_names = ["web"]
+  type                 = "IN"
+  cidr_ip              = "0.0.0.0/0"
+}
+
+resource "nifcloud_db_instance" "db" {
+  identifier          = "db"
+  publicly_accessible = true
+}
+
+resource "nifcloud_db_security_group" "dbsg" {
+  group_name = "dbsg"
+  rule {
+    cidr_ip = "0.0.0.0/0"
+  }
+}
+''')
+    assert {"AVD-NIF-0001", "AVD-NIF-0002", "AVD-NIF-0003",
+            "AVD-NIF-0008", "AVD-NIF-0010"} <= fails
+
+
+def test_nifcloud_network_checks():
+    fails, _ = scan_tf('''
+resource "nifcloud_elb" "front" {
+  protocol = "HTTP"
+  lb_port  = 80
+}
+
+resource "nifcloud_router" "r" {
+  name = "r"
+}
+
+resource "nifcloud_vpn_gateway" "gw" {
+  nifty_private_network_id = "x"
+}
+''')
+    assert {"AVD-NIF-0019", "AVD-NIF-0016", "AVD-NIF-0018"} <= fails
+
+
+def test_clean_configs_pass():
+    fails, out = scan_tf('''
+resource "digitalocean_droplet" "worker" {
+  image    = "ubuntu-22-04-x64"
+  ssh_keys = ["fingerprint"]
+}
+
+resource "nifcloud_security_group" "web" {
+  group_name  = "web"
+  description = "frontend"
+}
+''')
+    assert not {f for f in fails if f.startswith(("AVD-DIG", "AVD-NIF"))}
+    # PASS results recorded for evaluated checks
+    passed = {s.id for mc in out for s in mc.successes}
+    assert "AVD-DIG-0004" in passed

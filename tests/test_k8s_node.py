@@ -1,0 +1,126 @@
+"""Node-collector-equivalent infra assessment + k8s compliance specs
+(ref: pkg/k8s node-collector path, trivy-checks KCV set, pkg/compliance)."""
+
+import json
+import subprocess
+import sys
+
+from trivy_tpu import k8s, k8s_node
+
+GOOD_INFO = {
+    "kubeletServiceFilePermissions": {"values": [600]},
+    "kubeletServiceFileOwnership": {"values": ["root:root"]},
+    "kubeletConfFilePermissions": {"values": [600]},
+    "kubeletConfFileOwnership": {"values": ["root:root"]},
+    "kubeletAnonymousAuthArgumentSet": {"values": ["false"]},
+    "kubeletAuthorizationModeArgumentSet": {"values": ["Webhook"]},
+    "kubeletClientCaFileArgumentSet": {"values": ["/etc/kubernetes/pki/ca.crt"]},
+    "kubeletReadOnlyPortArgumentSet": {"values": ["0"]},
+    "kubeletStreamingConnectionIdleTimeoutArgumentSet": {"values": ["4h"]},
+    "kubeletProtectKernelDefaultsArgumentSet": {"values": ["true"]},
+    "kubeletMakeIptablesUtilChainsArgumentSet": {"values": ["true"]},
+    "kubeletHostnameOverrideArgumentSet": {"values": [""]},
+    "kubeletEventQpsArgumentSet": {"values": ["5"]},
+    "kubeletTlsCertFileTlsArgumentSet": {"values": ["/var/lib/kubelet/pki/kubelet.crt"]},
+    "kubeletTlsPrivateKeyFileArgumentSet": {"values": ["/var/lib/kubelet/pki/kubelet.key"]},
+    "kubeletRotateCertificatesArgumentSet": {"values": ["true"]},
+    "kubeletRotateKubeletServerCertificateArgumentSet": {"values": ["true"]},
+}
+
+
+def _node_doc(info, name="worker-1"):
+    return {
+        "apiVersion": "v1",
+        "kind": "NodeInfo",
+        "type": "node-collector",
+        "metadata": {"name": name},
+        "info": info,
+    }
+
+
+def test_good_node_passes():
+    mc = k8s_node.scan_node_info(_node_doc(GOOD_INFO))
+    assert not mc.failures
+    assert {r.id for r in mc.successes} >= {"KCV0079", "KCV0082", "KCV0090"}
+
+
+def test_bad_node_fails_expected_checks():
+    bad = dict(GOOD_INFO)
+    bad["kubeletAnonymousAuthArgumentSet"] = {"values": ["true"]}
+    bad["kubeletReadOnlyPortArgumentSet"] = {"values": ["10255"]}
+    bad["kubeletConfFilePermissions"] = {"values": [777]}
+    bad["kubeletAuthorizationModeArgumentSet"] = {"values": ["AlwaysAllow"]}
+    mc = k8s_node.scan_node_info(_node_doc(bad))
+    failed = {r.id for r in mc.failures}
+    assert {"KCV0079", "KCV0082", "KCV0073", "KCV0080"} <= failed
+    by_id = {r.id: r for r in mc.failures}
+    assert by_id["KCV0079"].severity == "CRITICAL"
+    assert by_id["KCV0079"].resource == "worker-1"
+
+
+def test_permission_modes_are_octal():
+    # 600 decimal-rendered octal == 0o600 passes; 640 passes; 777 fails
+    for value, ok in ((600, True), (640, False), (400, True), (777, False)):
+        info = {"kubeletConfFilePermissions": {"values": [value]}}
+        mc = k8s_node.scan_node_info(_node_doc(info))
+        status = {r.id: r.status for r in mc.failures + mc.successes}
+        assert (status["KCV0073"] == "PASS") is ok, value
+
+
+def test_missing_required_key_reported_when_collected_empty():
+    info = {"kubeletClientCaFileArgumentSet": {"values": []}}
+    mc = k8s_node.scan_node_info(_node_doc(info))
+    # key present but empty -> the collector looked and found nothing: FAIL
+    assert "KCV0081" in {r.id for r in mc.failures}
+    # keys the collector never gathered stay PASS (no evidence)
+    assert "KCV0088" in {r.id for r in mc.successes}
+
+
+def test_scan_workloads_includes_node_rows():
+    docs = [
+        _node_doc(GOOD_INFO),
+        {"kind": "Deployment", "metadata": {"name": "web", "namespace": "d"},
+         "spec": {"template": {"spec": {"containers": [
+             {"name": "c", "image": "nginx"}]}}}},
+    ]
+    rows = k8s.scan_workloads(docs)
+    kinds = {r["kind"] for r in rows}
+    assert "NodeInfo" in kinds and "Deployment" in kinds
+
+
+def test_k8s_cis_compliance_cli(tmp_path):
+    dump = {
+        "apiVersion": "v1",
+        "kind": "List",
+        "items": [
+            _node_doc({**GOOD_INFO,
+                       "kubeletAnonymousAuthArgumentSet": {"values": ["true"]}}),
+            {"apiVersion": "apps/v1", "kind": "Deployment",
+             "metadata": {"name": "web", "namespace": "default"},
+             "spec": {"template": {"spec": {"containers": [
+                 {"name": "c", "image": "nginx",
+                  "securityContext": {"privileged": True}}]}}}},
+        ],
+    }
+    p = tmp_path / "dump.json"
+    p.write_text(json.dumps(dump))
+    r = subprocess.run(
+        [sys.executable, "-m", "trivy_tpu.cli", "k8s",
+         "--manifests", str(p), "--compliance", "k8s-cis-1.23",
+         "--format", "json"],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    statuses = {c["ID"]: c["Status"] for c in doc["Results"]}
+    assert statuses["4.2.1"] == "FAIL"  # anonymous auth true on the node
+    assert statuses["4.2.4"] == "PASS"  # read-only port 0
+    assert statuses["5.2.2"] == "FAIL"  # privileged container
+    assert statuses["1.2.1"] == "MANUAL"
+
+
+def test_eks_cis_spec_loads():
+    from trivy_tpu.compliance import load_spec
+
+    spec = load_spec("eks-cis-1.4")
+    assert any(c.checks == ["KCV0079"] for c in spec.controls)

@@ -1,0 +1,190 @@
+"""Redis cache backend against an in-process fake RESP server
+(ref: pkg/cache/redis.go; same zero-egress technique as the fake
+registry/daemon)."""
+
+import socket
+import socketserver
+import threading
+
+import pytest
+
+
+class FakeRedis:
+    """Tiny RESP2 server: SET/GET/DEL/EXISTS/SCAN/PING/AUTH/SELECT over a
+    dict; enough to exercise the client completely."""
+
+    def __init__(self, password: str = ""):
+        self.data: dict[str, bytes] = {}
+        self.ttls: dict[str, int] = {}
+        self.password = password
+        self.commands: list[list[str]] = []
+
+    def start(self):
+        fake = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                authed = not fake.password
+                while True:
+                    line = self.rfile.readline()
+                    if not line or not line.startswith(b"*"):
+                        return
+                    n = int(line[1:])
+                    args = []
+                    for _ in range(n):
+                        ln = self.rfile.readline()
+                        assert ln.startswith(b"$")
+                        size = int(ln[1:])
+                        args.append(self.rfile.read(size + 2)[:-2])
+                    cmd = args[0].decode().upper()
+                    rest = [a.decode() for a in args[1:]]
+                    fake.commands.append([cmd] + rest)
+                    if cmd == "AUTH":
+                        if rest[-1] == fake.password:
+                            authed = True
+                            self.wfile.write(b"+OK\r\n")
+                        else:
+                            self.wfile.write(b"-ERR invalid password\r\n")
+                        continue
+                    if not authed:
+                        self.wfile.write(b"-NOAUTH Authentication required\r\n")
+                        continue
+                    if cmd == "PING":
+                        self.wfile.write(b"+PONG\r\n")
+                    elif cmd == "SELECT":
+                        self.wfile.write(b"+OK\r\n")
+                    elif cmd == "SET":
+                        fake.data[rest[0]] = rest[1].encode()
+                        if len(rest) >= 4 and rest[2].upper() == "EX":
+                            fake.ttls[rest[0]] = int(rest[3])
+                        self.wfile.write(b"+OK\r\n")
+                    elif cmd == "GET":
+                        v = fake.data.get(rest[0])
+                        if v is None:
+                            self.wfile.write(b"$-1\r\n")
+                        else:
+                            self.wfile.write(
+                                b"$%d\r\n%s\r\n" % (len(v), v)
+                            )
+                    elif cmd == "EXISTS":
+                        self.wfile.write(
+                            b":%d\r\n" % sum(k in fake.data for k in rest)
+                        )
+                    elif cmd == "DEL":
+                        n = 0
+                        for k in rest:
+                            n += fake.data.pop(k, None) is not None
+                        self.wfile.write(b":%d\r\n" % n)
+                    elif cmd == "SCAN":
+                        import fnmatch
+
+                        pat = rest[rest.index("MATCH") + 1] if "MATCH" in rest else "*"
+                        keys = [
+                            k.encode() for k in fake.data
+                            if fnmatch.fnmatch(k, pat)
+                        ]
+                        out = [b"*2\r\n", b"$1\r\n0\r\n",
+                               b"*%d\r\n" % len(keys)]
+                        for k in keys:
+                            out.append(b"$%d\r\n%s\r\n" % (len(k), k))
+                        self.wfile.write(b"".join(out))
+                    else:
+                        self.wfile.write(b"-ERR unknown command\r\n")
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+@pytest.fixture
+def fake_redis():
+    s = FakeRedis().start()
+    yield s
+    s.stop()
+
+
+def test_roundtrip_blobs_and_artifacts(fake_redis):
+    from trivy_tpu.cache import new_cache
+
+    cache = new_cache(f"redis://127.0.0.1:{fake_redis.port}")
+    cache.put_artifact("sha256:art", {"SchemaVersion": 2, "OS": "alpine"})
+    cache.put_blob("sha256:blob1", {"Digest": "d1"})
+    assert cache.get_artifact("sha256:art")["OS"] == "alpine"
+    assert cache.get_blob("sha256:blob1") == {"Digest": "d1"}
+    assert cache.get_blob("sha256:missing") is None
+    missing_art, missing = cache.missing_blobs(
+        "sha256:art", ["sha256:blob1", "sha256:blob2"]
+    )
+    assert missing_art is False
+    assert missing == ["sha256:blob2"]
+    cache.delete_blobs(["sha256:blob1"])
+    assert cache.get_blob("sha256:blob1") is None
+    cache.close()
+
+
+def test_keys_use_fanal_namespace_and_ttl(fake_redis):
+    from trivy_tpu.cache.redis import RedisCache
+
+    cache = RedisCache(f"redis://127.0.0.1:{fake_redis.port}", ttl=3600)
+    cache.put_blob("sha256:b", {"x": 1})
+    assert "fanal::blob::sha256:b" in fake_redis.data
+    assert fake_redis.ttls["fanal::blob::sha256:b"] == 3600
+    cache.close()
+
+
+def test_auth_and_db_select():
+    s = FakeRedis(password="hunter2").start()
+    try:
+        from trivy_tpu.cache.redis import RedisCache, RedisError
+
+        with pytest.raises(RedisError):
+            RedisCache(f"redis://127.0.0.1:{s.port}")  # no password
+        cache = RedisCache(f"redis://:hunter2@127.0.0.1:{s.port}/2")
+        assert ["SELECT", "2"] in s.commands
+        cache.put_artifact("a", {"v": 1})
+        assert cache.get_artifact("a") == {"v": 1}
+        cache.close()
+    finally:
+        s.stop()
+
+
+def test_clear_scans_both_prefixes(fake_redis):
+    from trivy_tpu.cache.redis import RedisCache
+
+    cache = RedisCache(f"redis://127.0.0.1:{fake_redis.port}")
+    cache.put_artifact("a1", {})
+    cache.put_blob("b1", {})
+    fake_redis.data["unrelated"] = b"keep"
+    cache.clear()
+    assert list(fake_redis.data) == ["unrelated"]
+    cache.close()
+
+
+def test_scan_through_redis_cache(fake_redis, tmp_path):
+    """A real fs scan stores its artifact+blob records in redis and a
+    second scan hits the cache."""
+    import os
+
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    (tmp_path / "app.py").write_text("x = 1\n")
+    cache = new_cache(f"redis://127.0.0.1:{fake_redis.port}")
+    art = LocalFSArtifact(str(tmp_path), cache, ArtifactOption(backend="cpu"))
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=["secret"])
+    )
+    assert report.artifact_name
+    assert any(k.startswith("fanal::blob::") for k in fake_redis.data)
+    cache.close()

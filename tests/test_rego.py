@@ -1,0 +1,405 @@
+"""Rego-subset interpreter: language coverage, real-world trivy ignore
+policies and custom checks running unmodified, and clear errors on
+unsupported constructs (ref: pkg/result/filter.go applyPolicy,
+pkg/iac/rego/scanner.go)."""
+
+import pytest
+
+from trivy_tpu.rego import RegoError, parse_module
+
+
+def ev(src, rule="ignore", input=None):
+    return parse_module(src).eval_rule(rule, input=input)
+
+
+# -- language basics ---------------------------------------------------------
+
+
+def test_default_and_simple_rule():
+    src = """
+package trivy
+
+default ignore = false
+
+ignore {
+    input.VulnerabilityID == "CVE-2022-0001"
+}
+"""
+    assert ev(src, input={"VulnerabilityID": "CVE-2022-0001"}) is True
+    assert ev(src, input={"VulnerabilityID": "CVE-2099-9999"}) is False
+
+
+def test_multiple_bodies_are_or():
+    src = """
+package trivy
+default ignore = false
+ignore { input.Severity == "LOW" }
+ignore { input.Severity == "UNKNOWN" }
+"""
+    assert ev(src, input={"Severity": "UNKNOWN"}) is True
+    assert ev(src, input={"Severity": "HIGH"}) is False
+
+
+def test_iteration_with_underscore_and_some():
+    src = """
+package trivy
+default ignore = false
+ignore {
+    input.PkgPath != ""
+    ignore_paths[_] == input.PkgPath
+}
+ignore_paths := ["vendor/", "third_party/"]
+"""
+    assert ev(src, input={"PkgPath": "vendor/"}) is True
+    assert ev(src, input={"PkgPath": "src/"}) is False
+
+
+def test_some_in_and_membership():
+    src = """
+package trivy
+default ignore = false
+ignore {
+    some cve in ignore_list
+    cve == input.VulnerabilityID
+}
+ignore_list := ["CVE-1", "CVE-2"]
+"""
+    assert ev(src, input={"VulnerabilityID": "CVE-2"}) is True
+    src2 = """
+package trivy
+default ignore = false
+ignore { input.VulnerabilityID in {"CVE-1", "CVE-2"} }
+"""
+    assert ev(src2, input={"VulnerabilityID": "CVE-1"}) is True
+    assert ev(src2, input={"VulnerabilityID": "CVE-9"}) is False
+
+
+def test_not_and_builtins():
+    src = """
+package trivy
+default ignore = false
+ignore {
+    startswith(input.PkgName, "kernel-")
+    not is_critical
+}
+is_critical { input.Severity == "CRITICAL" }
+"""
+    assert ev(src, input={"PkgName": "kernel-headers", "Severity": "LOW"}) is True
+    assert (
+        ev(src, input={"PkgName": "kernel-headers", "Severity": "CRITICAL"})
+        is False
+    )
+    assert ev(src, input={"PkgName": "bash", "Severity": "LOW"}) is False
+
+
+def test_nested_refs_and_object_walk():
+    src = """
+package trivy
+default ignore = false
+ignore {
+    input.Vulnerability.CVSS.nvd.V3Score < 7.0
+}
+"""
+    assert ev(src, input={"Vulnerability": {"CVSS": {"nvd": {"V3Score": 5.1}}}}) is True
+    assert ev(src, input={"Vulnerability": {"CVSS": {"nvd": {"V3Score": 9.8}}}}) is False
+    # missing path -> rule undefined -> default
+    assert ev(src, input={}) is False
+
+
+def test_partial_set_rule_and_contains_syntax():
+    legacy = """
+package user.kubernetes.ID001
+deny[msg] {
+    input.kind == "Deployment"
+    msg := sprintf("%s is deployed", [input.metadata.name])
+}
+"""
+    members = parse_module(legacy).eval_rule(
+        "deny", input={"kind": "Deployment", "metadata": {"name": "app"}}
+    )
+    assert members == ["app is deployed"]
+    v1 = """
+package user.kubernetes.ID001
+deny contains msg if {
+    input.kind == "Deployment"
+    msg := "nope"
+}
+"""
+    assert parse_module(v1).eval_rule("deny", input={"kind": "Deployment"}) == ["nope"]
+
+
+def test_comprehensions_and_count():
+    src = """
+package trivy
+default ignore = false
+ignore {
+    fixed := [v | some v in input.vulns; v.fixed == true]
+    count(fixed) == count(input.vulns)
+}
+"""
+    assert ev(src, input={"vulns": [{"fixed": True}, {"fixed": True}]}) is True
+    assert ev(src, input={"vulns": [{"fixed": True}, {"fixed": False}]}) is False
+
+
+def test_arithmetic_and_sprintf():
+    src = """
+package t
+msg := sprintf("%d of %d (%v)", [passed, total, input.name])
+passed := 3
+total := passed + 1
+"""
+    assert parse_module(src).eval_rule("msg", input={"name": "x"}) == "3 of 4 (x)"
+
+
+def test_regex_and_string_builtins():
+    src = """
+package trivy
+default ignore = false
+ignore {
+    regex.match("^CVE-20(1|2)[0-9]-", input.VulnerabilityID)
+    contains(lower(input.PkgName), "test")
+}
+"""
+    assert ev(src, input={"VulnerabilityID": "CVE-2021-1", "PkgName": "MyTest"}) is True
+    assert ev(src, input={"VulnerabilityID": "RHSA-2021", "PkgName": "MyTest"}) is False
+
+
+def test_object_and_array_literals():
+    src = """
+package t
+out := {"a": [1, 2], "b": input.x}
+"""
+    assert parse_module(src).eval_rule("out", input={"x": 9}) == {"a": [1, 2], "b": 9}
+
+
+def test_rule_value_reference_between_rules():
+    src = """
+package t
+threshold := 7
+default flag = false
+flag { input.score >= threshold }
+"""
+    assert parse_module(src).eval_rule("flag", input={"score": 8}) is True
+    assert parse_module(src).eval_rule("flag", input={"score": 3}) is False
+
+
+def test_unification_destructuring():
+    src = """
+package t
+default ok = false
+ok {
+    [a, b] = input.pair
+    a == b
+}
+"""
+    assert parse_module(src).eval_rule("ok", input={"pair": [2, 2]}) is True
+    assert parse_module(src).eval_rule("ok", input={"pair": [1, 2]}) is False
+
+
+# -- unsupported constructs error clearly ------------------------------------
+
+
+@pytest.mark.parametrize("src,needle", [
+    ("package t\nf(x) = y { y := x }", "function"),
+    ("package t\nr { every x in input.xs { x > 0 } }", "every"),
+    ("package t\nr { x := input.a with input as {} }", "with"),
+])
+def test_unsupported_constructs(src, needle):
+    with pytest.raises(RegoError, match=needle):
+        parse_module(src).eval_rule("r", input={})
+
+
+def test_recursion_detected():
+    src = """
+package t
+a { b }
+b { a }
+"""
+    with pytest.raises(RegoError, match="recursive"):
+        parse_module(src).eval_rule("a", input={})
+
+
+# -- integration: --ignore-policy --------------------------------------------
+
+
+REAL_WORLD_POLICY = """
+package trivy
+
+import data.lib.trivy
+
+default ignore = false
+
+ignore_vulnerability_ids := {
+    "CVE-2022-27191",
+    "CVE-2018-20699"
+}
+
+ignore_severities := ["LOW", "MEDIUM"]
+
+nvd_v3_vector = v {
+    v := input.CVSS.nvd.V3Vector
+}
+
+ignore {
+    input.VulnerabilityID == ignore_vulnerability_ids[_]
+}
+
+ignore {
+    input.Severity == ignore_severities[_]
+}
+
+ignore {
+    input.PkgPath != ""
+    startswith(input.PkgPath, "usr/local/lib/node_modules")
+}
+"""
+
+
+def test_real_world_ignore_policy(tmp_path):
+    from trivy_tpu.result import IgnorePolicy
+
+    p = tmp_path / "ignore.rego"
+    p.write_text(REAL_WORLD_POLICY)
+    pol = IgnorePolicy(str(p))
+    assert pol.has_predicate("vulnerability")
+    assert pol.ignores("vulnerability", {"VulnerabilityID": "CVE-2022-27191"})
+    assert pol.ignores("vulnerability", {"VulnerabilityID": "CVE-0", "Severity": "LOW"})
+    assert pol.ignores(
+        "vulnerability",
+        {"PkgPath": "usr/local/lib/node_modules/x", "VulnerabilityID": "C", "Severity": "HIGH"},
+    )
+    assert not pol.ignores(
+        "vulnerability",
+        {"VulnerabilityID": "CVE-1", "Severity": "CRITICAL", "PkgPath": ""},
+    )
+
+
+def test_rego_policy_filters_report(tmp_path):
+    from trivy_tpu.result import FilterOptions, filter_report
+    from trivy_tpu.types import DetectedVulnerability, Report, Result
+
+    p = tmp_path / "pol.rego"
+    p.write_text(
+        "package trivy\ndefault ignore = false\n"
+        'ignore { input.VulnerabilityID == "CVE-GONE" }\n'
+    )
+    report = Report(
+        artifact_name="x",
+        results=[Result(target="t", vulnerabilities=[
+            DetectedVulnerability(vulnerability_id="CVE-GONE", pkg_name="a",
+                                  installed_version="1", severity="HIGH"),
+            DetectedVulnerability(vulnerability_id="CVE-STAYS", pkg_name="a",
+                                  installed_version="1", severity="HIGH"),
+        ])],
+    )
+    out = filter_report(report, FilterOptions(policy_file=str(p)))
+    ids = [v.vulnerability_id for v in out.results[0].vulnerabilities]
+    assert ids == ["CVE-STAYS"]
+
+
+def test_policy_without_ignore_rule_errors(tmp_path):
+    from trivy_tpu.result import IgnorePolicy, PolicyError
+
+    p = tmp_path / "pol.rego"
+    p.write_text("package trivy\nallow { true }\n")
+    with pytest.raises(PolicyError, match="ignore"):
+        IgnorePolicy(str(p))
+
+
+# -- integration: custom rego checks -----------------------------------------
+
+
+K8S_CHECK = """
+# METADATA
+# title: "Deployment not allowed"
+# description: "Deployments are not allowed in this cluster."
+# custom:
+#   id: USR-K8S-100
+#   severity: CRITICAL
+#   input:
+#     selector:
+#     - type: kubernetes
+package user.kubernetes.USR100
+
+deny[msg] {
+    input.kind == "Deployment"
+    msg := sprintf("deployment %s is forbidden", [input.metadata.name])
+}
+"""
+
+LEGACY_CHECK = """
+package user.dockerfile.ID002
+
+__rego_metadata__ := {
+    "id": "USR-DF-200",
+    "title": "no curl in RUN",
+    "severity": "HIGH",
+}
+
+__rego_input__ := {"selector": [{"type": "dockerfile"}]}
+
+deny[msg] {
+    some stage in input.Stages
+    some cmd in stage.Commands
+    cmd.Cmd == "run"
+    some arg in cmd.Value
+    contains(arg, "curl")
+    msg := "RUN uses curl"
+}
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_custom_checks():
+    yield
+    from trivy_tpu.misconf import custom
+    from trivy_tpu.misconf.checks import unregister
+
+    for cid in list(custom._custom_ids):
+        unregister(cid)
+    custom._custom_ids.clear()
+    custom._loaded_files.clear()
+
+
+def test_rego_kubernetes_check(tmp_path):
+    from trivy_tpu.misconf.custom import load_custom_checks
+    from trivy_tpu.misconf.scanner import MisconfScanner, ScannerOption
+
+    p = tmp_path / "k8s.rego"
+    p.write_text(K8S_CHECK)
+    assert load_custom_checks([str(p)]) == 1
+    manifest = (
+        b"apiVersion: apps/v1\nkind: Deployment\n"
+        b"metadata:\n  name: web\nspec: {}\n"
+    )
+    scanner = MisconfScanner(ScannerOption())
+    out = scanner.scan_files([("deploy.yaml", manifest)])
+    fails = [f for mc in out for f in mc.failures]
+    assert any(
+        f.id == "USR-K8S-100" and "deployment web is forbidden" in f.message
+        for f in fails
+    ), fails
+    assert any(f.severity == "CRITICAL" for f in fails)
+
+
+def test_legacy_rego_dockerfile_check(tmp_path):
+    from trivy_tpu.misconf.custom import load_custom_checks
+    from trivy_tpu.misconf.scanner import MisconfScanner, ScannerOption
+
+    p = tmp_path / "df.rego"
+    p.write_text(LEGACY_CHECK)
+    assert load_custom_checks([str(p)]) == 1
+    df = b"FROM alpine:3.18\nRUN curl http://x | sh\n"
+    scanner = MisconfScanner(ScannerOption())
+    out = scanner.scan_files([("Dockerfile", df)])
+    fails = [f for mc in out for f in mc.failures]
+    assert any(f.id == "USR-DF-200" for f in fails), fails
+
+
+def test_rego_check_unsupported_construct_errors(tmp_path):
+    from trivy_tpu.misconf.custom import CustomCheckError, load_custom_checks
+
+    p = tmp_path / "bad.rego"
+    p.write_text("package user.x\ndeny[m] { every v in input.xs { v } ; m := \"x\" }\n")
+    with pytest.raises(CustomCheckError, match="every"):
+        load_custom_checks([str(p)])

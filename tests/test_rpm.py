@@ -146,14 +146,54 @@ def test_rpm_analyzer_ndb_paths():
     assert r.package_infos[0].packages[0].name == "bash"
 
 
-def test_bdb_unsupported_is_graceful():
-    # BerkeleyDB hash magic at offset 12
+def test_bdb_truncated_is_graceful():
+    # a bare magic with no valid meta page must not crash the analyzer
     content = b"\0" * 12 + (0x00061561).to_bytes(4, "little") + b"\0" * 64
     a = RpmAnalyzer(None)
     info = FileInfo(size=len(content), mode=0o644)
     assert a.analyze(
         AnalysisInput(dir="/x", file_path="var/lib/rpm/Packages", info=info, content=content)
     ) is None
+
+
+def test_bdb_hash_packages_read():
+    """CentOS-7-style BerkeleyDB 'Packages': off-page blobs spanning
+    multiple overflow pages, both endiannesses, and inline small blobs."""
+    blobs = [_bash_header(), _openssl_header()]
+    # force a multi-page overflow chain with a large file list
+    for content_kind in ("le", "be", "inline"):
+        db = rpmdb.build_bdb(
+            blobs,
+            big_endian=(content_kind == "be"),
+            inline_threshold=(10**6 if content_kind == "inline" else 0),
+        )
+        assert rpmdb.detect_format(db) == "bdb"
+        headers = rpmdb.read_headers(db)
+        names = [h.str_(rpmdb.TAG_NAME) for h in headers]
+        assert names == ["bash", "openssl"], content_kind
+
+
+def test_bdb_multipage_overflow_chain():
+    big = rpmdb.encode_header_blob({
+        rpmdb.TAG_NAME: "bigpkg",
+        rpmdb.TAG_VERSION: "1.0",
+        rpmdb.TAG_RELEASE: "1.el7",
+        rpmdb.TAG_ARCH: "x86_64",
+        rpmdb.TAG_BASENAMES: [f"file{i}" for i in range(2000)],
+        rpmdb.TAG_DIRINDEXES: [0] * 2000,
+        rpmdb.TAG_DIRNAMES: ["/usr/share/bigpkg/"],
+    })
+    db = rpmdb.build_bdb([big], pagesize=512)
+    assert len(big) > 512 * 3  # really spans many overflow pages
+    headers = rpmdb.read_headers(db)
+    assert headers[0].str_(rpmdb.TAG_NAME) == "bigpkg"
+    assert len(headers[0].list_(rpmdb.TAG_BASENAMES)) == 2000
+
+
+def test_rpm_analyzer_bdb_path():
+    content = rpmdb.build_bdb([_bash_header()])
+    r = _run("var/lib/rpm/Packages", content)
+    assert r.package_infos[0].packages[0].name == "bash"
 
 
 def test_modular_advisory_lookup(tmp_path):

@@ -297,3 +297,98 @@ class TestIgnorePolicy:
         assert [v.vulnerability_id for v in res.vulnerabilities] == ["CVE-2024-2"]
         assert res.modified_findings[0].status == "ignored"
         assert res.modified_findings[0].source == str(ign)
+
+
+class TestVexRepositories:
+    """VEX repository resolution (ref: pkg/vex/repo/): local cache layout,
+    config order precedence, version-less purl index keys."""
+
+    def _make_repo(self, cache, name, pkg_id, vuln_id, doc_purl):
+        repo = cache / "vex" / "repositories" / name
+        idx_dir = repo / "0.1"
+        idx_dir.mkdir(parents=True)
+        (repo / "vex-repository.json").write_text(json.dumps({
+            "name": name, "description": "", 
+            "versions": [{"spec_version": "0.1",
+                          "locations": [{"url": "https://x"}],
+                          "update_interval": "24h"}],
+        }))
+        doc = {
+            "@context": "https://openvex.dev/ns/v0.2.0",
+            "statements": [{
+                "vulnerability": {"name": vuln_id},
+                "products": [{"@id": doc_purl}],
+                "status": "not_affected",
+                "justification": "vulnerable_code_not_in_execute_path",
+            }],
+        }
+        (idx_dir / f"{name}.openvex.json").write_text(json.dumps(doc))
+        (idx_dir / "index.json").write_text(json.dumps({
+            "updated_at": "2024-01-01T00:00:00Z",
+            "packages": [{"id": pkg_id,
+                          "location": f"{name}.openvex.json",
+                          "format": "openvex"}],
+        }))
+        return repo
+
+    def _write_config(self, cache, names):
+        vex_dir = cache / "vex"
+        vex_dir.mkdir(parents=True, exist_ok=True)
+        (vex_dir / "repository.yaml").write_text(
+            "repositories:\n" + "".join(
+                f"  - name: {n}\n    url: https://example/{n}\n    enabled: true\n"
+                for n in names
+            )
+        )
+
+    def test_package_id_strips_version_and_qualifiers(self):
+        rs = vex.RepositorySet
+        assert rs.package_id("pkg:pypi/liba@1.2.3?arch=x86#sub") == "pkg:pypi/liba"
+        assert rs.package_id(
+            "pkg:golang/github.com/aquasecurity/trivy@v0.57.0"
+        ) == "pkg:golang/github.com/aquasecurity/trivy"
+        oci = rs.package_id(
+            "pkg:oci/trivy@sha256:abc?repository_url=ghcr.io/aquasecurity/trivy&arch=amd64"
+        )
+        assert oci == "pkg:oci/trivy?repository_url=ghcr.io%2Faquasecurity%2Ftrivy"
+
+    def test_repo_resolution_filters_vuln(self, tmp_path):
+        self._make_repo(tmp_path, "myrepo", "pkg:pypi/liba",
+                        "CVE-2024-0001", "pkg:pypi/liba@1.2.3")
+        self._write_config(tmp_path, ["myrepo"])
+        report = _report(_vuln())
+        vex.filter_report(report, ["repo"], cache_dir=str(tmp_path))
+        assert report.results[0].vulnerabilities == []
+        mf = report.results[0].modified_findings[0]
+        assert mf.status == "not_affected"
+        assert "myrepo" in mf.source
+
+    def test_first_repo_with_package_wins(self, tmp_path):
+        # repo1 knows the package but a different CVE -> stops there,
+        # repo2's matching doc must NOT be consulted
+        self._make_repo(tmp_path, "repo1", "pkg:pypi/liba",
+                        "CVE-1999-9999", "pkg:pypi/liba@1.2.3")
+        self._make_repo(tmp_path, "repo2", "pkg:pypi/liba",
+                        "CVE-2024-0001", "pkg:pypi/liba@1.2.3")
+        self._write_config(tmp_path, ["repo1", "repo2"])
+        report = _report(_vuln())
+        vex.filter_report(report, ["repo"], cache_dir=str(tmp_path))
+        assert [v.vulnerability_id for v in report.results[0].vulnerabilities] == [
+            "CVE-2024-0001"
+        ]
+
+    def test_missing_repo_dir_is_skipped(self, tmp_path):
+        self._write_config(tmp_path, ["ghost"])
+        report = _report(_vuln())
+        vex.filter_report(report, ["repo"], cache_dir=str(tmp_path))
+        assert len(report.results[0].vulnerabilities) == 1
+
+    def test_disabled_repo_ignored(self, tmp_path):
+        self._make_repo(tmp_path, "off", "pkg:pypi/liba",
+                        "CVE-2024-0001", "pkg:pypi/liba@1.2.3")
+        (tmp_path / "vex" / "repository.yaml").write_text(
+            "repositories:\n  - name: off\n    url: https://x\n    enabled: false\n"
+        )
+        report = _report(_vuln())
+        vex.filter_report(report, ["repo"], cache_dir=str(tmp_path))
+        assert len(report.results[0].vulnerabilities) == 1

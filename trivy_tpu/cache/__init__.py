@@ -15,8 +15,9 @@ from trivy_tpu.cache.fs import FSCache  # noqa: F401
 from trivy_tpu.cache.memory import MemoryCache  # noqa: F401
 
 
-def new_cache(backend: str = "fs", cache_dir: str | None = None):
-    """Cache factory (ref: pkg/cache/cache.go New)."""
+def new_cache(backend: str = "fs", cache_dir: str | None = None, **kwargs):
+    """Cache factory (ref: pkg/cache/cache.go New). ``kwargs`` reach the
+    redis backend (ttl, ca_cert, client_cert, client_key)."""
     if backend == "memory":
         return MemoryCache()
     if backend in ("fs", ""):
@@ -25,4 +26,8 @@ def new_cache(backend: str = "fs", cache_dir: str | None = None):
         from trivy_tpu.rpc.client import RemoteCache
 
         return RemoteCache(backend)
+    if backend.startswith(("redis://", "rediss://")):
+        from trivy_tpu.cache.redis import RedisCache
+
+        return RedisCache(backend, **kwargs)
     raise ValueError(f"unknown cache backend: {backend}")

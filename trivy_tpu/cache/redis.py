@@ -1,0 +1,194 @@
+"""Redis cache backend (ref: pkg/cache/redis.go RedisCache).
+
+Server fleets share one scan cache; the reference backs it with Redis
+using ``fanal::artifact::<id>`` / ``fanal::blob::<id>`` keys, an optional
+TTL, and optional TLS with a custom CA. This is a dependency-free RESP2
+client over a plain socket speaking exactly the commands the cache needs
+(AUTH/SELECT/SET/GET/DEL/SCAN/PING), so ``--cache-backend redis://host``
+works against any Redis-compatible server — and against the in-process
+fake RESP server the tests run (same zero-egress technique as the
+registry/daemon fakes).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import ssl
+import urllib.parse
+
+from trivy_tpu import log
+
+logger = log.logger("cache:redis")
+
+ARTIFACT_PREFIX = "fanal::artifact::"
+BLOB_PREFIX = "fanal::blob::"
+
+
+class RedisError(ConnectionError):
+    pass
+
+
+class _Resp:
+    """Minimal RESP2 codec over a buffered socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rfile = sock.makefile("rb")
+
+    def command(self, *args: str | bytes):
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a.encode() if isinstance(a, str) else a
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        self.sock.sendall(b"".join(out))
+        return self._reply()
+
+    def _reply(self):
+        line = self.rfile.readline()
+        if not line:
+            raise RedisError("connection closed by redis server")
+        kind, rest = line[:1], line[1:-2]
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RedisError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self.rfile.read(n + 2)[:-2]
+            return data
+        if kind == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._reply() for _ in range(n)]
+        raise RedisError(f"unexpected RESP reply: {line!r}")
+
+    def close(self):
+        try:
+            self.rfile.close()
+        finally:
+            self.sock.close()
+
+
+class RedisCache:
+    """Blob/artifact cache over Redis (same interface as FSCache).
+
+    ``url``: ``redis://[:password@]host:port[/db]`` (``rediss://`` for
+    TLS). ``ttl`` seconds (0 = no expiry); ``ca_cert``/``client_cert``/
+    ``client_key`` mirror the reference's --redis-ca/cert/key flags.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        ttl: int = 0,
+        ca_cert: str = "",
+        client_cert: str = "",
+        client_key: str = "",
+        timeout: float = 10.0,
+    ):
+        u = urllib.parse.urlparse(url)
+        if u.scheme not in ("redis", "rediss"):
+            raise ValueError(f"not a redis URL: {url}")
+        self.ttl = int(ttl)
+        host = u.hostname or "localhost"
+        port = u.port or 6379
+        sock = socket.create_connection((host, port), timeout=timeout)
+        if u.scheme == "rediss" or ca_cert or client_cert:
+            ctx = ssl.create_default_context(
+                cafile=ca_cert or None
+            )
+            if client_cert:
+                ctx.load_cert_chain(client_cert, client_key or None)
+            if not ca_cert:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            sock = ctx.wrap_socket(sock, server_hostname=host)
+        self._resp = _Resp(sock)
+        if u.password:
+            if u.username:
+                self._resp.command("AUTH", u.username, u.password)
+            else:
+                self._resp.command("AUTH", u.password)
+        db = (u.path or "/").lstrip("/")
+        if db:
+            self._resp.command("SELECT", db)
+        self._resp.command("PING")
+
+    # -- the cache interface (FSCache-compatible) -----------------------
+
+    def _set(self, key: str, obj: dict) -> None:
+        data = json.dumps(obj, separators=(",", ":"))
+        if self.ttl > 0:
+            self._resp.command("SET", key, data, "EX", str(self.ttl))
+        else:
+            self._resp.command("SET", key, data)
+
+    def _get(self, key: str) -> dict | None:
+        data = self._resp.command("GET", key)
+        if data is None:
+            return None
+        try:
+            return json.loads(data)
+        except json.JSONDecodeError:
+            logger.warning("corrupt cache entry %s dropped", key)
+            return None
+
+    def put_artifact(self, artifact_id: str, info: dict) -> None:
+        self._set(ARTIFACT_PREFIX + artifact_id, info)
+
+    def put_blob(self, blob_id: str, info: dict) -> None:
+        self._set(BLOB_PREFIX + blob_id, info)
+
+    def get_artifact(self, artifact_id: str) -> dict | None:
+        return self._get(ARTIFACT_PREFIX + artifact_id)
+
+    def get_blob(self, blob_id: str) -> dict | None:
+        return self._get(BLOB_PREFIX + blob_id)
+
+    def missing_blobs(
+        self, artifact_id: str, blob_ids: list[str]
+    ) -> tuple[bool, list[str]]:
+        missing = [
+            b for b in blob_ids
+            if self._resp.command("EXISTS", BLOB_PREFIX + b) == 0
+        ]
+        missing_artifact = (
+            self._resp.command("EXISTS", ARTIFACT_PREFIX + artifact_id) == 0
+        )
+        return missing_artifact, missing
+
+    def delete_blobs(self, blob_ids: list[str]) -> None:
+        if blob_ids:
+            self._resp.command(
+                "DEL", *[BLOB_PREFIX + b for b in blob_ids]
+            )
+
+    def clear(self) -> None:
+        for prefix in (ARTIFACT_PREFIX, BLOB_PREFIX):
+            cursor = "0"
+            while True:
+                reply = self._resp.command(
+                    "SCAN", cursor, "MATCH", prefix + "*", "COUNT", "100"
+                )
+                cursor = (
+                    reply[0].decode()
+                    if isinstance(reply[0], bytes)
+                    else str(reply[0])
+                )
+                keys = reply[1] or []
+                if keys:
+                    self._resp.command(
+                        "DEL",
+                        *[k.decode() if isinstance(k, bytes) else k for k in keys],
+                    )
+                if cursor == "0":
+                    break
+
+    def close(self) -> None:
+        self._resp.close()

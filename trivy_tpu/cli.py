@@ -31,6 +31,16 @@ def global_flags() -> FlagGroup:
                  config_name="quiet", short="q"),
             Flag("cache-dir", default=None, help="cache directory",
                  config_name="cache.dir"),
+            Flag("cache-backend", default=None, config_name="cache.backend",
+                 help="scan cache backend: fs, memory, redis://host:port"),
+            Flag("cache-ttl", default=None, config_name="cache.ttl",
+                 help="redis cache TTL in seconds"),
+            Flag("redis-ca", default=None, config_name="cache.redis.ca",
+                 help="redis TLS CA certificate path"),
+            Flag("redis-cert", default=None, config_name="cache.redis.cert",
+                 help="redis TLS client certificate path"),
+            Flag("redis-key", default=None, config_name="cache.redis.key",
+                 help="redis TLS client key path"),
             Flag("config", default=None, help="config file path", short="c"),
             Flag("timeout", default=300, value_type=int, config_name="timeout",
                  help="scan timeout seconds (ref default 5m)"),
@@ -271,6 +281,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="allow plain-HTTP registries for image pulls")
     kp.add_argument("--db-repository", default=None,
                     help="advisory DB location for image vulnerability scans")
+    kp.add_argument("--compliance", default=None,
+                    help="compliance spec over the scan (k8s-cis-1.23, "
+                         "eks-cis-1.4, k8s-nsa-1.0, @path)")
 
     pp = sub.add_parser("plugin", help="manage plugins (install/list/run/uninstall)")
     psub = pp.add_subparsers(dest="plugin_cmd")
@@ -315,6 +328,35 @@ def main(argv: list[str] | None = None) -> int:
             log.logger("cli").error("%s", e)
             return 1
         rows = k8s.scan_workloads(docs)
+        if ns.compliance:
+            from trivy_tpu.compliance import apply_spec, load_spec, write_report
+            from trivy_tpu.types import Report, Result
+
+            try:
+                spec = load_spec(ns.compliance)
+            except (OSError, ValueError) as e:
+                log.logger("cli").error("%s", e)
+                return 1
+            report = Report(
+                artifact_name="k8s cluster",
+                results=[
+                    Result(
+                        target=f"{r['namespace']}/{r['kind']}/{r['name']}",
+                        cls="config",
+                        misconfigurations=(
+                            list(r["failures"]) + list(r.get("successes", []))
+                        ),
+                    )
+                    for r in rows
+                ],
+            )
+            creport = apply_spec(spec, report)
+            if ns.output:
+                with open(ns.output, "w") as f:
+                    write_report(creport, f, ns.format)
+            else:
+                write_report(creport, _sys.stdout, ns.format)
+            return 0
         image_rows = None
         if ns.scan_images:
             from trivy_tpu.db import load_default_db

@@ -18,7 +18,16 @@ logger = log.logger("commands")
 def _make_cache(opts):
     from trivy_tpu.cache import new_cache
 
-    return new_cache("fs", opts.get("cache_dir"))
+    backend = opts.get("cache_backend") or "fs"
+    kwargs = {}
+    if backend.startswith(("redis://", "rediss://")):
+        kwargs = {
+            "ttl": int(opts.get("cache_ttl") or 0),
+            "ca_cert": opts.get("redis_ca") or "",
+            "client_cert": opts.get("redis_cert") or "",
+            "client_key": opts.get("redis_key") or "",
+        }
+    return new_cache(backend, opts.get("cache_dir"), **kwargs)
 
 
 def _artifact_option(ns, opts):
@@ -171,6 +180,7 @@ def _emit(report, ns, opts) -> int:
             vex_sources=opts.get("vex") or [],
             policy_file=opts.get("ignore_policy"),
             show_suppressed=bool(opts.get("show_suppressed")),
+            cache_dir=opts.get("cache_dir") or "",
         ),
     )
     compliance = opts.get("compliance")

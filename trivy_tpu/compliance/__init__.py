@@ -225,4 +225,129 @@ BUILTIN_SPECS: dict[str, ComplianceSpec] = {
                     severity="MEDIUM", default_status="MANUAL"),
         ],
     ),
+    # CIS Kubernetes Benchmark (worker-node sections evaluated through the
+    # node-collector-equivalent KCV checks, trivy_tpu/k8s_node.py; policy
+    # sections through the KSV workload checks; control-plane flag checks
+    # need the master collector -> MANUAL, like the reference marks
+    # non-collectable controls)
+    "k8s-cis-1.23": ComplianceSpec(
+        id="k8s-cis-1.23",
+        title="CIS Kubernetes Benchmark v1.23",
+        version="1.23",
+        controls=[
+            Control(id="1.2.1", name="Ensure --anonymous-auth argument is false (API server)",
+                    severity="CRITICAL", default_status="MANUAL"),
+            Control(id="1.2.6", name="Ensure --authorization-mode is not AlwaysAllow (API server)",
+                    severity="CRITICAL", default_status="MANUAL"),
+            Control(id="4.1.1", name="Ensure kubelet service file permissions are 600 or more restrictive",
+                    severity="HIGH", checks=["KCV0069"]),
+            Control(id="4.1.2", name="Ensure kubelet service file ownership is root:root",
+                    severity="HIGH", checks=["KCV0070"]),
+            Control(id="4.1.3", name="If proxy kubeconfig exists ensure permissions are 600",
+                    severity="HIGH", checks=["KCV0071"]),
+            Control(id="4.1.4", name="If proxy kubeconfig exists ensure ownership is root:root",
+                    severity="HIGH", checks=["KCV0072"]),
+            Control(id="4.1.5", name="Ensure kubelet.conf file permissions are 600 or more restrictive",
+                    severity="HIGH", checks=["KCV0073"]),
+            Control(id="4.1.6", name="Ensure kubelet.conf file ownership is root:root",
+                    severity="HIGH", checks=["KCV0074"]),
+            Control(id="4.1.7", name="Ensure certificate authorities file permissions are 600",
+                    severity="CRITICAL", checks=["KCV0075"]),
+            Control(id="4.1.8", name="Ensure client CA file ownership is root:root",
+                    severity="CRITICAL", checks=["KCV0076"]),
+            Control(id="4.1.9", name="Ensure kubelet config.yaml permissions are 600",
+                    severity="HIGH", checks=["KCV0077"]),
+            Control(id="4.1.10", name="Ensure kubelet config.yaml ownership is root:root",
+                    severity="HIGH", checks=["KCV0078"]),
+            Control(id="4.2.1", name="Ensure --anonymous-auth argument is false",
+                    severity="CRITICAL", checks=["KCV0079"]),
+            Control(id="4.2.2", name="Ensure --authorization-mode is not AlwaysAllow",
+                    severity="CRITICAL", checks=["KCV0080"]),
+            Control(id="4.2.3", name="Ensure --client-ca-file argument is set",
+                    severity="CRITICAL", checks=["KCV0081"]),
+            Control(id="4.2.4", name="Verify that --read-only-port is 0",
+                    severity="HIGH", checks=["KCV0082"]),
+            Control(id="4.2.5", name="Ensure --streaming-connection-idle-timeout is not 0",
+                    severity="HIGH", checks=["KCV0083"]),
+            Control(id="4.2.6", name="Ensure --protect-kernel-defaults is true",
+                    severity="HIGH", checks=["KCV0084"]),
+            Control(id="4.2.7", name="Ensure --make-iptables-util-chains is true",
+                    severity="HIGH", checks=["KCV0085"]),
+            Control(id="4.2.8", name="Ensure --hostname-override is not set",
+                    severity="HIGH", checks=["KCV0086"]),
+            Control(id="4.2.9", name="Ensure --event-qps captures events",
+                    severity="HIGH", checks=["KCV0087"]),
+            Control(id="4.2.10", name="Ensure --tls-cert-file and --tls-private-key-file are set",
+                    severity="CRITICAL", checks=["KCV0088", "KCV0089"]),
+            Control(id="4.2.11", name="Ensure --rotate-certificates is present",
+                    severity="HIGH", checks=["KCV0090"]),
+            Control(id="4.2.12", name="Verify RotateKubeletServerCertificate is true",
+                    severity="HIGH", checks=["KCV0091"]),
+            Control(id="5.1.6", name="Ensure service account tokens only mounted when necessary",
+                    severity="MEDIUM", default_status="MANUAL"),
+            Control(id="5.2.2", name="Minimize admission of privileged containers",
+                    severity="HIGH", checks=["KSV017"]),
+            Control(id="5.2.3", name="Minimize wanting to share the host PID namespace",
+                    severity="HIGH", checks=["KSV009"]),
+            Control(id="5.2.4", name="Minimize admission of hostIPC containers",
+                    severity="HIGH", checks=["KSV008"]),
+            Control(id="5.2.5", name="Minimize admission of hostNetwork containers",
+                    severity="HIGH", checks=["KSV010"]),
+            Control(id="5.2.6", name="Minimize admission of allowPrivilegeEscalation",
+                    severity="HIGH", checks=["KSV001"]),
+            Control(id="5.2.7", name="Minimize admission of root containers",
+                    severity="MEDIUM", checks=["KSV012"]),
+            Control(id="5.2.8", name="Minimize admission of NET_RAW capability",
+                    severity="MEDIUM", checks=["KSV003"]),
+            Control(id="5.7.3", name="Apply security context to pods and containers",
+                    severity="MEDIUM", checks=["KSV014"]),
+        ],
+    ),
+    "eks-cis-1.4": ComplianceSpec(
+        id="eks-cis-1.4",
+        title="AWS EKS CIS Benchmark v1.4",
+        version="1.4",
+        controls=[
+            Control(id="3.1.1", name="Ensure kubeconfig file permissions are 644 or more restrictive",
+                    severity="HIGH", checks=["KCV0071"]),
+            Control(id="3.1.2", name="Ensure kubelet kubeconfig file ownership is root:root",
+                    severity="HIGH", checks=["KCV0072"]),
+            Control(id="3.1.3", name="Ensure kubelet config file permissions are 644 or more restrictive",
+                    severity="HIGH", checks=["KCV0077"]),
+            Control(id="3.1.4", name="Ensure kubelet config file ownership is root:root",
+                    severity="HIGH", checks=["KCV0078"]),
+            Control(id="3.2.1", name="Ensure anonymous auth is not enabled",
+                    severity="CRITICAL", checks=["KCV0079"]),
+            Control(id="3.2.2", name="Ensure --authorization-mode is not AlwaysAllow",
+                    severity="CRITICAL", checks=["KCV0080"]),
+            Control(id="3.2.3", name="Ensure a client CA file is configured",
+                    severity="CRITICAL", checks=["KCV0081"]),
+            Control(id="3.2.4", name="Ensure --read-only-port is disabled",
+                    severity="HIGH", checks=["KCV0082"]),
+            Control(id="3.2.5", name="Ensure --streaming-connection-idle-timeout is not 0",
+                    severity="HIGH", checks=["KCV0083"]),
+            Control(id="3.2.6", name="Ensure --make-iptables-util-chains is true",
+                    severity="HIGH", checks=["KCV0085"]),
+            Control(id="3.2.7", name="Ensure --event-qps captures events",
+                    severity="HIGH", checks=["KCV0087"]),
+            Control(id="3.2.8", name="Ensure --rotate-certificates is true",
+                    severity="HIGH", checks=["KCV0090"]),
+            Control(id="3.2.9", name="Ensure RotateKubeletServerCertificate is true",
+                    severity="HIGH", checks=["KCV0091"]),
+            Control(id="4.2.1", name="Minimize admission of privileged containers",
+                    severity="HIGH", checks=["KSV017"]),
+            Control(id="4.2.2", name="Minimize hostPID sharing",
+                    severity="HIGH", checks=["KSV009"]),
+            Control(id="4.2.3", name="Minimize hostIPC sharing",
+                    severity="HIGH", checks=["KSV008"]),
+            Control(id="4.2.4", name="Minimize hostNetwork sharing",
+                    severity="HIGH", checks=["KSV010"]),
+            Control(id="4.2.5", name="Minimize allowPrivilegeEscalation",
+                    severity="HIGH", checks=["KSV001"]),
+            Control(id="4.2.6", name="Minimize admission of root containers",
+                    severity="MEDIUM", checks=["KSV012"]),
+            Control(id="5.1.1", name="Ensure image vulnerability scanning (ECR or third party)",
+                    severity="MEDIUM", default_status="MANUAL"),
+        ],
+    ),
 }

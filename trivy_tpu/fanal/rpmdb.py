@@ -16,8 +16,15 @@ data. Containers:
   pages, and 16-byte-aligned blob records (header magic ``BlbS``, pkgidx,
   checksum, length) holding the header blob.
 
-BerkeleyDB (pre-2020 ``Packages``) is not supported; callers get a clear
-error naming the format.
+- **bdb** (pre-rpm-4.16 ``Packages``): a BerkeleyDB *hash* database
+  (libdb db_page.h layouts; the reference reads it through go-rpmdb,
+  SURVEY §2.2). Page 0 is the hash metadata page (magic ``0x061561`` at
+  offset 12, page size at 20, last_pgno at 32; a byte-swapped magic flags
+  an opposite-endian file). Hash pages (type 2/13) carry a uint16 slot
+  array of in-page offsets alternating key/data items; rpm keys are
+  4-byte package numbers, and header blobs are usually ``H_OFFPAGE``
+  items whose ``(pgno, tlen)`` chain of type-7 overflow pages carries the
+  blob (``hf_offset`` = bytes used per overflow page).
 """
 
 from __future__ import annotations
@@ -215,6 +222,92 @@ def _iter_ndb_blobs(content: bytes):
         yield pkgidx, content[boff + 16 : boff + 16 + blen]
 
 
+# BDB page types (libdb db_page.h)
+_BDB_P_OVERFLOW = 7
+_BDB_P_HASHMETA = 8
+_BDB_HASH_PAGES = (2, 13)  # P_HASH_UNSORTED, P_HASH
+# hash item types
+_BDB_H_KEYDATA = 1
+_BDB_H_OFFPAGE = 3
+_BDB_PAGE_HDR = 26
+
+
+def _iter_bdb_blobs(content: bytes):
+    """(pkg_number, header_blob) pairs from a BerkeleyDB hash ``Packages``."""
+    if len(content) < 512:
+        raise RpmDBError("bdb: file too short")
+    (magic_le,) = struct.unpack_from("<I", content, 12)
+    if magic_le == _BDB_HASH_MAGICS[0]:
+        E = "<"
+    elif magic_le == _BDB_HASH_MAGICS[1]:
+        E = ">"
+    else:
+        raise RpmDBError("bdb: bad hash metadata magic")
+    (pagesize,) = struct.unpack_from(E + "I", content, 20)
+    if content[25] != _BDB_P_HASHMETA:
+        raise RpmDBError("bdb: page 0 is not a hash metadata page")
+    if pagesize < 512 or pagesize > 64 * 1024 or pagesize & (pagesize - 1):
+        raise RpmDBError(f"bdb: implausible page size {pagesize}")
+    (last_pgno,) = struct.unpack_from(E + "I", content, 32)
+    npages = min(last_pgno + 1, len(content) // pagesize)
+
+    def overflow_chain(pgno: int, tlen: int) -> bytes:
+        out = bytearray()
+        seen = set()
+        while pgno and len(out) < tlen:
+            if pgno in seen or pgno >= npages:
+                raise RpmDBError("bdb: broken overflow chain")
+            seen.add(pgno)
+            base = pgno * pagesize
+            if content[base + 25] != _BDB_P_OVERFLOW:
+                raise RpmDBError("bdb: expected overflow page")
+            (next_pgno,) = struct.unpack_from(E + "I", content, base + 16)
+            (used,) = struct.unpack_from(E + "H", content, base + 22)
+            used = min(used, pagesize - _BDB_PAGE_HDR)
+            out += content[base + _BDB_PAGE_HDR : base + _BDB_PAGE_HDR + used]
+            pgno = next_pgno
+        if len(out) < tlen:
+            raise RpmDBError("bdb: truncated overflow item")
+        return bytes(out[:tlen])
+
+    for pgno in range(1, npages):
+        base = pgno * pagesize
+        if content[base + 25] not in _BDB_HASH_PAGES:
+            continue
+        (entries,) = struct.unpack_from(E + "H", content, base + 20)
+        if entries < 2 or _BDB_PAGE_HDR + 2 * entries > pagesize:
+            continue
+        inp = struct.unpack_from(E + f"{entries}H", content, base + _BDB_PAGE_HDR)
+
+        def item_len(k: int) -> int:
+            # items fill the page back-to-front in slot order, so an item
+            # runs from its offset to the previous slot's offset (page end
+            # for slot 0) — libdb's LEN_HITEM
+            hi = pagesize if k == 0 else inp[k - 1]
+            return hi - inp[k]
+
+        for i in range(0, entries - 1, 2):
+            koff, doff = inp[i], inp[i + 1]
+            if not (0 < koff < pagesize and 0 < doff < pagesize):
+                continue
+            if content[base + koff] != _BDB_H_KEYDATA:
+                continue  # off-page/duplicate keys never happen for rpm
+            klen = item_len(i) - 1
+            key = content[base + koff + 1 : base + koff + 1 + klen]
+            pkgidx = (
+                struct.unpack(E + "I", key)[0] if klen == 4 else 0
+            )
+            if pkgidx == 0:
+                continue  # rpm package numbers start at 1
+            dtype = content[base + doff]
+            if dtype == _BDB_H_OFFPAGE:
+                opgno, tlen = struct.unpack_from(E + "II", content, base + doff + 4)
+                yield pkgidx, overflow_chain(opgno, tlen)
+            elif dtype == _BDB_H_KEYDATA:
+                dlen = item_len(i + 1) - 1
+                yield pkgidx, content[base + doff + 1 : base + doff + 1 + dlen]
+
+
 def detect_format(content: bytes) -> str:
     if content.startswith(_SQLITE_MAGIC):
         return "sqlite"
@@ -235,10 +328,7 @@ def read_headers(content: bytes) -> list[RpmHeader]:
     elif fmt == "ndb":
         rows = sorted(_iter_ndb_blobs(content), key=lambda t: t[0])
     elif fmt == "bdb":
-        raise RpmDBError(
-            "BerkeleyDB rpmdb (pre-rpm-4.16 'Packages') is not supported; "
-            "convert with `rpmdb --rebuilddb` on a modern rpm"
-        )
+        rows = sorted(_iter_bdb_blobs(content), key=lambda t: t[0])
     else:
         raise RpmDBError("unrecognized rpmdb format")
     out = []
@@ -298,6 +388,70 @@ def build_sqlite_db(blobs: list[bytes]) -> bytes:
     out = con.serialize()
     con.close()
     return bytes(out)
+
+
+def build_bdb(blobs: list[bytes], pagesize: int = 4096,
+              big_endian: bool = False, inline_threshold: int = 0) -> bytes:
+    """Minimal well-formed BerkeleyDB hash ``Packages`` fixture: one meta
+    page, one hash page of key/data slots, and type-7 overflow chains for
+    blobs above ``inline_threshold`` (rpm headers are off-page in practice;
+    a non-zero threshold exercises the inline H_KEYDATA path)."""
+    E = ">" if big_endian else "<"
+    pages: list[bytearray] = []
+
+    def new_page(ptype: int) -> bytearray:
+        p = bytearray(pagesize)
+        p[25] = ptype
+        pages.append(p)
+        return p
+
+    meta = new_page(_BDB_P_HASHMETA)
+    struct.pack_into(E + "I", meta, 8, 0)  # pgno
+    # packing the canonical magic in the file's own byte order yields the
+    # swapped value when read little-endian — exactly what detect sees
+    struct.pack_into(E + "I", meta, 12, _BDB_HASH_MAGICS[0])
+    struct.pack_into(E + "I", meta, 16, 9)  # version
+    struct.pack_into(E + "I", meta, 20, pagesize)
+    hash_page = new_page(_BDB_HASH_PAGES[1])
+    struct.pack_into(E + "I", hash_page, 8, 1)
+    items: list[bytes] = []
+    overflow_next = 2  # next free page number
+    chains: list[tuple[int, bytes]] = []
+    for i, blob in enumerate(blobs):
+        pkgidx = i + 1
+        items.append(bytes([_BDB_H_KEYDATA]) + struct.pack(E + "I", pkgidx))
+        if len(blob) <= inline_threshold:
+            items.append(bytes([_BDB_H_KEYDATA]) + blob)
+        else:
+            per = pagesize - _BDB_PAGE_HDR
+            npg = max(1, -(-len(blob) // per))
+            items.append(
+                bytes([_BDB_H_OFFPAGE, 0, 0, 0])
+                + struct.pack(E + "II", overflow_next, len(blob))
+            )
+            chains.append((overflow_next, blob))
+            overflow_next += npg
+    # slot array + back-to-front item placement (libdb layout)
+    entries = len(items)
+    struct.pack_into(E + "H", hash_page, 20, entries)
+    off = pagesize
+    for k, item in enumerate(items):
+        off -= len(item)
+        hash_page[off : off + len(item)] = item
+        struct.pack_into(E + "H", hash_page, _BDB_PAGE_HDR + 2 * k, off)
+    struct.pack_into(E + "H", hash_page, 22, off)  # hf_offset
+    for start_pgno, blob in chains:
+        per = pagesize - _BDB_PAGE_HDR
+        pieces = [blob[j : j + per] for j in range(0, len(blob), per)] or [b""]
+        for j, piece in enumerate(pieces):
+            p = new_page(_BDB_P_OVERFLOW)
+            struct.pack_into(E + "I", p, 8, start_pgno + j)
+            nxt = start_pgno + j + 1 if j + 1 < len(pieces) else 0
+            struct.pack_into(E + "I", p, 16, nxt)
+            struct.pack_into(E + "H", p, 22, len(piece))
+            p[_BDB_PAGE_HDR : _BDB_PAGE_HDR + len(piece)] = piece
+    struct.pack_into(E + "I", pages[0], 32, len(pages) - 1)  # last_pgno
+    return b"".join(bytes(p) for p in pages)
 
 
 def build_ndb(blobs: list[bytes]) -> bytes:

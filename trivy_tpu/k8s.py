@@ -116,10 +116,27 @@ def scan_workloads(docs: list[dict], scanner: MisconfScanner | None = None):
     [{namespace, kind, name, severities{...}, failures[...]}]."""
     import yaml
 
+    from trivy_tpu import k8s_node
+
     scanner = scanner or MisconfScanner(ScannerOption(file_types=["kubernetes"]))
     rows = []
     for doc in docs:
         kind = doc.get("kind", "")
+        if k8s_node.is_node_info(doc):
+            # node-collector output in the dump: infra assessment rows
+            mc = k8s_node.scan_node_info(doc)
+            sev = {s: 0 for s in SEVERITIES}
+            for f in mc.failures:
+                sev[f.severity if f.severity in sev else "UNKNOWN"] += 1
+            rows.append({
+                "namespace": "node",
+                "kind": "NodeInfo",
+                "name": mc.file_path.split("/", 1)[-1],
+                "severities": sev,
+                "failures": list(mc.failures),
+                "successes": list(mc.successes),
+            })
+            continue
         if kind not in WORKLOAD_KINDS:
             continue
         meta = doc.get("metadata", {}) or {}

@@ -114,6 +114,7 @@ def _load_builtins() -> None:
         import trivy_tpu.misconf.arm  # noqa: F401  (azure cloud checks)
         import trivy_tpu.misconf.checks.cloud_aws  # noqa: F401
         import trivy_tpu.misconf.checks.cloud_azure  # noqa: F401
+        import trivy_tpu.misconf.checks.cloud_extra  # noqa: F401
         import trivy_tpu.misconf.checks.cloud_github  # noqa: F401
         import trivy_tpu.misconf.checks.cloud_google  # noqa: F401
         import trivy_tpu.misconf.checks.docker  # noqa: F401

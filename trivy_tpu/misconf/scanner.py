@@ -159,10 +159,26 @@ class MisconfScanner:
         """Adapt parsed terraform resources into every provider's typed
         state and evaluate the provider check sets, merging per file (ref:
         pkg/iac/adapters/terraform/* each adapting one provider)."""
-        from trivy_tpu.misconf.adapters import aws_tf, azure_tf, github_state, google_tf
+        from trivy_tpu.misconf.adapters import (
+            aws_tf,
+            azure_tf,
+            extra_providers,
+            github_state,
+            google_tf,
+        )
 
         merged: dict[str, Misconfiguration] = {}
-        for adapt in (aws_tf.adapt, azure_tf.adapt, google_tf.adapt, github_state.adapt):
+        for adapt in (
+            aws_tf.adapt,
+            azure_tf.adapt,
+            google_tf.adapt,
+            github_state.adapt,
+            extra_providers.adapt_digitalocean,
+            extra_providers.adapt_openstack,
+            extra_providers.adapt_oracle,
+            extra_providers.adapt_cloudstack,
+            extra_providers.adapt_nifcloud,
+        ):
             try:
                 state = adapt(resources)
             except Exception as e:

@@ -106,6 +106,7 @@ class FilterOptions:
     vex_sources: list[str] = field(default_factory=list)
     policy_file: str | None = None  # --ignore-policy
     show_suppressed: bool = False  # keep suppressed-only results in output
+    cache_dir: str = ""  # VEX repositories live under <cache>/vex/
 
 
 class PolicyError(ValueError):
@@ -134,6 +135,29 @@ class IgnorePolicy:
 
     def __init__(self, path: str):
         self.path = path
+        if path.endswith(".rego"):
+            # the reference's native policy format runs unmodified through
+            # the rego-subset interpreter: query data.trivy.ignore over each
+            # finding, exactly pkg/result/filter.go applyPolicy
+            from trivy_tpu import rego
+
+            try:
+                with open(path, encoding="utf-8") as f:
+                    self._rego_mod = rego.parse_module(f.read())
+            except (OSError, rego.RegoError) as e:
+                raise PolicyError(
+                    f"ignore policy {path} failed to load: {e}"
+                ) from e
+            names = self._rego_mod.rule_names()
+            if "ignore" not in names:
+                raise PolicyError(
+                    f"ignore policy {path} defines no 'ignore' rule "
+                    f"(rules found: {', '.join(names) or 'none'})"
+                )
+            self._fns = dict.fromkeys(self._KINDS)
+            self._generic = None
+            return
+        self._rego_mod = None
         ns: dict = {"__file__": path, "__name__": "trivy_ignore_policy"}
         try:
             with open(path, encoding="utf-8") as f:
@@ -149,9 +173,20 @@ class IgnorePolicy:
             )
 
     def has_predicate(self, kind: str) -> bool:
+        if self._rego_mod is not None:
+            return True  # rego policies see every finding kind
         return self._fns.get(kind) is not None or self._generic is not None
 
     def ignores(self, kind: str, finding_dict: dict) -> bool:
+        if self._rego_mod is not None:
+            from trivy_tpu import rego
+
+            try:
+                return bool(self._rego_mod.eval_rule("ignore", finding_dict))
+            except rego.RegoError as e:
+                raise PolicyError(
+                    f"ignore policy {self.path}: {e}"
+                ) from e
         fn = self._fns.get(kind)
         try:
             if fn is not None:
@@ -168,7 +203,7 @@ def filter_report(report: Report, options: FilterOptions) -> Report:
     if options.vex_sources:
         from trivy_tpu import vex
 
-        vex.filter_report(report, options.vex_sources)
+        vex.filter_report(report, options.vex_sources, options.cache_dir)
     ignores = IgnoreConfig.load(options.ignore_file)
     policy = IgnorePolicy(options.policy_file) if options.policy_file else None
     sevs = set(options.severities)

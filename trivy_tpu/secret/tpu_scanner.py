@@ -27,6 +27,7 @@ release the GIL).
 
 from __future__ import annotations
 
+import queue
 import threading
 from collections import deque
 from collections.abc import Iterable, Iterator
@@ -153,25 +154,64 @@ class TpuSecretScanner:
             self._match = match_fn
             row_multiple = 1
         # dispatch-shape bucket ladder: every shape compiles exactly once
-        # (variable trailing-batch shapes would recompile per distinct size)
+        # (variable trailing-batch shapes would recompile per distinct size).
+        # The ladder stops at B/4: each extra rung costs a full Mosaic
+        # compile of every kernel (~minutes through a remote-compile
+        # tunnel), while padding a short trailing batch up to B/4 rows
+        # costs microseconds of device time
         buckets = [self.batch_size]
-        while buckets[-1] // 2 >= max(8, row_multiple):
+        while (
+            buckets[-1] // 2 >= max(8, row_multiple, self.batch_size // 4)
+        ):
             buckets.append(buckets[-1] // 2)
         self._buckets = sorted(buckets)
 
     # -- core batching loop -------------------------------------------------
 
-    def _run_batch(self, batch: np.ndarray) -> np.ndarray:
-        """device_put → match → fetch for one dispatch-shaped batch.
+    def _device_loop(self, in_q, out_q) -> None:
+        """Single device thread: dispatch batches asynchronously, defer the
+        blocking result fetch until the pipeline is full.
 
-        Runs on a worker thread: the host→device transfer and the blocking
-        device wait both release the GIL, so packing/confirm work on other
-        threads overlaps with the wire and the kernel.
+        One thread does BOTH dispatch and fetch on purpose: jax dispatch is
+        async, so batch N+1's host→device transfer proceeds while batch N's
+        kernel runs — full overlap from one thread — and keeping dispatch
+        and fetch off separate threads matters under the axon tunnel, whose
+        transfer journal only reclaims per-transfer buffers when transfers
+        and fetches don't interleave across threads (measured: the
+        two-thread pipeline retains ~0.9 byte/byte scanned; this loop with
+        identical depth is flat).
         """
-        with trace.span("secret.dispatch"):
-            dev = self._match(batch)
-        with trace.span("secret.device_wait"):
-            return np.asarray(dev)
+        pending: deque = deque()
+
+        def fetch_oldest():
+            dev, meta = pending.popleft()
+            with trace.span("secret.device_wait"):
+                out_q.put((np.asarray(dev), meta))
+
+        try:
+            while True:
+                item = in_q.get()
+                if item is None:
+                    break
+                batch, meta = item
+                with trace.span("secret.dispatch"):
+                    pending.append((self._match(batch), meta))
+                if len(pending) >= PIPELINE_DEPTH:
+                    fetch_oldest()
+            while pending:
+                fetch_oldest()
+        except BaseException as e:  # device/tunnel failure: surface it
+            # the feeder sees the exception on its next drain and raises;
+            # empty the queue first so a feeder blocked on a full in_q
+            # wakes up (its batches are lost — the scan is failing anyway)
+            while True:
+                try:
+                    in_q.get_nowait()
+                except queue.Empty:
+                    break
+            out_q.put(e)
+            return
+        out_q.put(None)
 
     def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
         """Scan many files; yields per-file results in input order."""
@@ -182,23 +222,28 @@ class TpuSecretScanner:
         next_emit = 0
         total = 0
 
-        # ring of host batch buffers: a buffer is only refilled once its
-        # batch task has resolved (inflight is bounded by PIPELINE_DEPTH), so
-        # no copy or re-zeroing per batch is needed — crucial because on the
-        # CPU backend jax may alias the numpy buffer zero-copy, and mutating
-        # a dispatched batch would corrupt it mid-flight
+        # ring of host batch buffers sized for every stage a batch can be
+        # in at once: queued to the device thread (PIPELINE_DEPTH), being
+        # dispatched (1), dispatched-but-unfetched (PIPELINE_DEPTH, matters
+        # on the CPU backend where jax may alias the numpy buffer
+        # zero-copy), plus the one being packed — refilling a ring slot
+        # can then never touch a batch still in any of those stages
         bufs = [
             np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
-            for _ in range(PIPELINE_DEPTH + 1)
+            for _ in range(2 * PIPELINE_DEPTH + 2)
         ]
         buf_i = 0
         buf = bufs[0]
         meta: list[int] = []  # file index per buffered chunk
-        inflight: deque = deque()  # (batch Future, meta_snapshot)
         pool = ThreadPoolExecutor(max_workers=self.confirm_workers)
-        # batch tasks overlap transfer N+1 with kernel N through the device
-        # queue; two threads suffice (more just contend on the link)
-        batch_pool = ThreadPoolExecutor(max_workers=2)
+        # the single device thread (see _device_loop); in_q's bound is the
+        # feeder backpressure, out_q carries fetched hit matrices back
+        in_q: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
+        out_q: queue.Queue = queue.Queue()
+        device_thread = threading.Thread(
+            target=self._device_loop, args=(in_q, out_q), daemon=True
+        )
+        device_thread.start()
         # backpressure: bounds queued+running confirms so a slow confirm
         # pool cannot accumulate unbounded _FileState.data on a large
         # streaming scan (file bytes are released once its confirm runs)
@@ -226,12 +271,27 @@ class TpuSecretScanner:
                     results[fidx] = pool.submit(confirm_task, st)
                     del states[fidx]
 
+        def drain_results(block: bool = False) -> bool:
+            """Resolve fetched batches; returns False once the device
+            thread signalled completion; re-raises a device failure."""
+            while True:
+                try:
+                    item = out_q.get(block=block)
+                except queue.Empty:
+                    return True
+                if item is None:
+                    return False
+                if isinstance(item, BaseException):
+                    raise item
+                resolve(*item)
+                block = False
+
         def flush():
             nonlocal meta, buf, buf_i
             if not meta:
                 return
             n = next(b for b in self._buckets if b >= len(meta))
-            inflight.append((batch_pool.submit(self._run_batch, buf[:n]), meta))
+            in_q.put((buf[:n], meta))
             meta = []
             # rotate to the next ring buffer; full rows are overwritten on
             # fill and partial rows zero their own tails (stale rows past
@@ -239,14 +299,13 @@ class TpuSecretScanner:
             # whole batch is needed
             buf_i = (buf_i + 1) % len(bufs)
             buf = bufs[buf_i]
-            while len(inflight) >= PIPELINE_DEPTH:
-                fut, m = inflight.popleft()
-                resolve(fut.result(), m)
+            drain_results()
 
         def drain() -> None:
-            while inflight:
-                fut, m = inflight.popleft()
-                resolve(fut.result(), m)
+            in_q.put(None)
+            while drain_results(block=True):
+                pass
+            device_thread.join()
 
         try:
             for fidx, (path, data) in enumerate(files):
@@ -282,7 +341,19 @@ class TpuSecretScanner:
                 next_emit += 1
         finally:
             pool.shutdown(wait=False)
-            batch_pool.shutdown(wait=False)
+            if device_thread.is_alive():
+                # generator closed early: make room if the queue is full,
+                # then deliver the shutdown sentinel (dropping it would
+                # leave the device thread blocked on in_q.get() forever)
+                while True:
+                    try:
+                        in_q.put_nowait(None)
+                        break
+                    except queue.Full:
+                        try:
+                            in_q.get_nowait()
+                        except queue.Empty:
+                            pass
 
     def scan_bytes(self, path: str, data: bytes) -> Secret:
         """Single-file convenience (still device-prefiltered)."""

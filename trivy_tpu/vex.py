@@ -277,11 +277,138 @@ def _load_csaf(doc: dict) -> list[Statement]:
 # ---------------------------------------------------------------------------
 
 
-def filter_report(report: Report, sources: list[str]) -> None:
+class RepositorySet:
+    """VEX repositories (ref: pkg/vex/repo/: manifest ``vex-repository.json``,
+    index at ``<repo>/0.1/index.json``, documents resolved relative to the
+    index). The ``--vex repo`` source reads the repository config
+    (``repository.yaml``, a ``repositories: [{name, url, enabled}]`` list),
+    then looks up each vulnerability's package by its version-less purl in
+    every enabled repository's index, in config order — first repository
+    holding the package wins (ref: pkg/vex/repo.go:90-113).
+
+    Zero-egress build: repositories must already be present in the cache
+    (``<cache>/vex/repositories/<name>/``); downloading is the env-blocked
+    seam, resolution/matching is complete.
+    """
+
+    SCHEMA_VERSION = "0.1"
+
+    def __init__(self, cache_dir: str, config_path: str = ""):
+        import yaml
+
+        self.indexes: list[tuple[str, str, dict, str]] = []
+        self._doc_cache: dict[str, VexDocument | None] = {}
+        config_path = config_path or os.path.join(
+            cache_dir, "vex", "repository.yaml"
+        )
+        if not os.path.exists(config_path):
+            alt = os.path.expanduser("~/.trivy/vex/repository.yaml")
+            config_path = alt if os.path.exists(alt) else config_path
+        try:
+            with open(config_path, encoding="utf-8") as f:
+                conf = yaml.safe_load(f) or {}
+        except (OSError, yaml.YAMLError) as e:
+            logger.warning(
+                "no usable VEX repository config at %s (%s); `--vex repo` "
+                "has nothing to consult", config_path, e,
+            )
+            return
+        for r in conf.get("repositories") or []:
+            if not (r or {}).get("enabled", True):
+                continue
+            name = str(r.get("name", ""))
+            repo_dir = os.path.join(cache_dir, "vex", "repositories", name)
+            index_path = os.path.join(
+                repo_dir, self.SCHEMA_VERSION, "index.json"
+            )
+            if not os.path.exists(index_path):
+                logger.warning(
+                    "VEX repository %s not found locally (%s), skipping",
+                    name, index_path,
+                )
+                continue
+            try:
+                with open(index_path, encoding="utf-8") as f:
+                    raw = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                logger.warning("VEX repository %s: bad index: %s", name, e)
+                continue
+            pkgs = {}
+            for entry in raw.get("packages") or raw.get("Packages") or []:
+                pid = entry.get("id") or entry.get("ID") or ""
+                if pid:
+                    pkgs[pid] = {
+                        "location": entry.get("location")
+                        or entry.get("Location") or "",
+                        "format": entry.get("format")
+                        or entry.get("Format") or "",
+                    }
+            self.indexes.append(
+                (name, str(r.get("url", "")), pkgs,
+                 os.path.dirname(index_path))
+            )
+
+    @staticmethod
+    def package_id(purl: str) -> str:
+        """Version/qualifier/subpath-less purl — the index key (vex-repo
+        spec §3.2; OCI keeps its repository_url qualifier)."""
+        from trivy_tpu.purl import PackageURL
+
+        try:
+            p = PackageURL.parse(purl)
+        except ValueError:
+            return ""
+        keep_q = {}
+        if p.type == "oci" and "repository_url" in p.qualifiers:
+            keep_q = {"repository_url": p.qualifiers["repository_url"]}
+        p.version = ""
+        p.qualifiers = keep_q
+        p.subpath = ""
+        return p.to_string()
+
+    def not_affected(self, vuln_id: str, purl: str) -> ModifiedFinding | None:
+        pkg_id = self.package_id(purl)
+        if not pkg_id:
+            return None
+        for name, url, pkgs, base_dir in self.indexes:
+            entry = pkgs.get(pkg_id)
+            if entry is None:
+                continue
+            loc = os.path.join(base_dir, entry["location"])
+            if loc not in self._doc_cache:
+                try:
+                    self._doc_cache[loc] = load(loc)
+                except (OSError, ValueError, json.JSONDecodeError) as e:
+                    logger.warning(
+                        "VEX repository %s: cannot load %s: %s", name, loc, e
+                    )
+                    self._doc_cache[loc] = None
+            doc = self._doc_cache[loc]
+            if doc is not None:
+                m = doc.not_affected(vuln_id, purl)
+                if m is not None:
+                    m.source = f"VEX Repository: {name} ({url})"
+                    return m
+            # higher-precedence repository holds the package: stop here
+            return None
+        return None
+
+
+def filter_report(
+    report: Report, sources: list[str], cache_dir: str = ""
+) -> None:
     """Drop vulnerabilities a VEX document marks not_affected/fixed;
-    record them as modified findings (ref: vex.go filterVulnerabilities)."""
+    record them as modified findings (ref: vex.go filterVulnerabilities).
+    A source of ``repo`` consults the local VEX repositories."""
     docs = []
     for src in sources:
+        if src == "repo":
+            if not cache_dir:
+                from trivy_tpu.cache.fs import default_cache_dir
+
+                cache_dir = default_cache_dir()
+            docs.append(RepositorySet(cache_dir))
+            continue
         try:
             docs.append(load(src))
         except (OSError, ValueError, json.JSONDecodeError) as e:
