@@ -1,0 +1,117 @@
+"""Build-metadata analyzers: Red Hat content manifests/Dockerfiles, apk
+repositories, executable digests, wordpress version; cosign-vuln writer
+(ref: pkg/fanal/analyzer/buildinfo, pkg/fanal/analyzer/repo/apk,
+pkg/fanal/analyzer/executable, pkg/report/predicate)."""
+
+import hashlib
+import io
+import json
+
+from trivy_tpu.fanal.analyzer import AnalysisInput
+from trivy_tpu.fanal.analyzers.buildinfo import (
+    ApkRepoAnalyzer,
+    BuildinfoDockerfileAnalyzer,
+    ContentManifestAnalyzer,
+    ExecutableAnalyzer,
+)
+from trivy_tpu.fanal.walker import FileInfo
+
+
+def _inp(path, content, mode=0o644):
+    return AnalysisInput(dir="/", file_path=path,
+                         info=FileInfo(size=len(content), mode=mode),
+                         content=content)
+
+
+def test_content_manifest():
+    a = ContentManifestAnalyzer(None)
+    path = "root/buildinfo/content_manifests/ubi8-container.json"
+    assert a.required(path, None)
+    assert not a.required("etc/content_manifests/x.json", None)
+    r = a.analyze(_inp(path, json.dumps(
+        {"content_sets": ["rhel-8-for-x86_64-baseos-rpms"]}).encode()))
+    assert r.build_info == {"ContentSets": ["rhel-8-for-x86_64-baseos-rpms"]}
+    assert a.analyze(_inp(path, b"{}")) is None
+
+
+def test_buildinfo_dockerfile_nvr():
+    a = BuildinfoDockerfileAnalyzer(None)
+    path = "root/buildinfo/Dockerfile-ubi8-8.5-204"
+    assert a.required(path, None)
+    content = b"""FROM sha256:x
+ENV VERSION=8.5
+LABEL com.redhat.component="ubi8-container" \\
+      architecture="x86_64" \\
+      release="204"
+"""
+    r = a.analyze(_inp(path, content))
+    assert r.build_info == {"Nvr": "ubi8-container-8.5-204", "Arch": "x86_64"}
+
+
+def test_apk_repositories():
+    a = ApkRepoAnalyzer(None)
+    assert a.required("etc/apk/repositories", None)
+    r = a.analyze(_inp("etc/apk/repositories",
+                       b"https://dl-cdn.alpinelinux.org/alpine/v3.18/main\n"
+                       b"https://dl-cdn.alpinelinux.org/alpine/v3.18/community\n"))
+    assert r.repository == {"Family": "alpine", "Release": "3.18"}
+    r2 = a.analyze(_inp("etc/apk/repositories",
+                        b"https://dl-cdn.alpinelinux.org/alpine/edge/main\n"
+                        b"https://dl-cdn.alpinelinux.org/alpine/v3.18/main\n"))
+    assert r2.repository["Release"] == "edge"
+
+
+def test_executable_digests():
+    a = ExecutableAnalyzer(None)
+    elf = b"\x7fELF" + b"\0" * 64
+    info = FileInfo(size=len(elf), mode=0o755)
+    assert a.required("usr/bin/tool", info)
+    assert not a.required("usr/share/doc", FileInfo(size=10, mode=0o644))
+    r = a.analyze(_inp("usr/bin/tool", elf, mode=0o755))
+    want = "sha256:" + hashlib.sha256(elf).hexdigest()
+    assert r.digests == {"usr/bin/tool": want}
+    # non-binary executable (shell script): skipped
+    assert a.analyze(_inp("usr/bin/x.sh", b"#!/bin/sh\n", mode=0o755)) is None
+
+
+def test_blobinfo_roundtrip_buildinfo_digests():
+    from trivy_tpu.types import BlobInfo
+
+    b = BlobInfo(build_info={"Nvr": "x-1-2", "Arch": "x86_64"},
+                 digests={"usr/bin/a": "sha256:ab"})
+    d = b.to_dict()
+    back = BlobInfo.from_dict(d)
+    assert back.build_info == b.build_info
+    assert back.digests == b.digests
+
+
+def test_wordpress_e2e(tmp_path):
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.scanner import ScanOptions, Scanner
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    wp = tmp_path / "site" / "wp-includes"
+    wp.mkdir(parents=True)
+    (wp / "version.php").write_text("<?php\n$wp_version = '6.4.2';\n")
+    cache = new_cache("fs", str(tmp_path / "cache"))
+    art = LocalFSArtifact(str(tmp_path / "site"), cache,
+                          ArtifactOption(backend="cpu"))
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=["vuln"], list_all_pkgs=True)
+    )
+    pkgs = [p for r in report.results for p in r.packages]
+    assert any(p.name == "wordpress" and p.version == "6.4.2" for p in pkgs)
+
+
+def test_cosign_vuln_writer():
+    from trivy_tpu.report import write
+    from trivy_tpu.types import Report, Result
+
+    buf = io.StringIO()
+    write(Report(artifact_name="img", results=[Result(target="t")]),
+          fmt="cosign-vuln", output=buf)
+    doc = json.loads(buf.getvalue())
+    assert set(doc) == {"invocation", "scanner", "metadata"}
+    assert doc["scanner"]["result"]["ArtifactName"] == "img"
+    assert doc["metadata"]["scanStartedOn"].endswith("Z")

@@ -124,3 +124,17 @@ def test_eks_cis_spec_loads():
 
     spec = load_spec("eks-cis-1.4")
     assert any(c.checks == ["KCV0079"] for c in spec.controls)
+
+
+def test_workload_rows_include_secret_class():
+    ghp = "ghp_" + "A1b2C3d4E5f6G7h8I9j0K1l2M3n4O5p6Q7r8"
+    docs = [{
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "d"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "c", "image": "nginx",
+             "env": [{"name": "TOKEN", "value": ghp}]}]}}},
+    }]
+    rows = k8s.scan_workloads(docs)
+    assert rows[0]["secrets"], "manifest secret not detected"
+    assert rows[0]["secrets"][0].rule_id == "github-pat"

@@ -18,7 +18,7 @@ from trivy_tpu.flag import Flag, FlagGroup, load_config_file, resolve_all
 VERSION = "0.1.0"
 
 SCANNERS = ["vuln", "misconfig", "secret", "license"]
-FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json", "github", "template"]
+FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json", "github", "template", "cosign-vuln"]
 
 
 def global_flags() -> FlagGroup:

@@ -765,3 +765,26 @@ def parse_packages_props(content: bytes, path: str = "") -> list[Package]:
             pkgs.append(_pkg(name, version))
     pkgs.sort(key=lambda p: (p.name, p.version))
     return pkgs
+
+
+# --- WordPress core version (ref: parser/frameworks/wordpress) --------------
+
+_WP_VERSION_RE = re.compile(r"^\$wp_version\s*=\s*['\"]([^'\"]+)['\"]\s*;")
+
+
+def parse_wordpress_version(content: bytes, path: str = "") -> list[Package]:
+    """wp-includes/version.php's ``$wp_version = '6.4.2';`` assignment,
+    with // and /* */ comments stripped the way the reference does."""
+    in_comment = False
+    for raw in content.decode("utf-8", "replace").splitlines():
+        line = raw.split("//", 1)[0].strip()
+        if line.startswith("/*"):
+            in_comment = True
+        if in_comment:
+            if line.endswith("*/"):
+                in_comment = False
+            continue
+        m = _WP_VERSION_RE.match(line)
+        if m:
+            return [_pkg("wordpress", m.group(1))]
+    return []

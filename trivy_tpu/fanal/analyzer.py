@@ -84,8 +84,13 @@ class AnalyzerType(str, enum.Enum):
     PACKAGES_PROPS = "packages-props"
     CONDA_ENV = "conda-environment"
     SBT_LOCK = "sbt-lockfile"
+    WORDPRESS = "wordpress"
     # others
     SECRET = "secret"
+    RED_HAT_CONTENT_MANIFEST = "redhat-content-manifest"
+    RED_HAT_DOCKERFILE = "redhat-dockerfile"
+    APK_REPO = "apk-repo"
+    EXECUTABLE = "executable"
     LICENSE_FILE = "license-file"
     LICENSE_HEADER = "license-header"
     CONFIG = "config"
@@ -109,6 +114,8 @@ class AnalysisResult:
 
     os: OS | None = None
     repository: dict | None = None
+    build_info: dict | None = None
+    digests: dict = field(default_factory=dict)
     package_infos: list[PackageInfo] = field(default_factory=list)
     applications: list[Application] = field(default_factory=list)
     misconfigurations: list[Misconfiguration] = field(default_factory=list)
@@ -124,6 +131,13 @@ class AnalysisResult:
             self.os = self.os.merge(other.os) if self.os else other.os
         if other.repository is not None:
             self.repository = other.repository
+        if other.build_info is not None:
+            # merge content-sets with nvr/arch coming from sibling files
+            merged = dict(self.build_info or {})
+            merged.update(other.build_info)
+            self.build_info = merged
+        if other.digests:
+            self.digests.update(other.digests)
         self.package_infos.extend(other.package_infos)
         self.applications.extend(other.applications)
         self.misconfigurations.extend(other.misconfigurations)
@@ -150,6 +164,8 @@ class AnalysisResult:
         return BlobInfo(
             os=self.os,
             repository=self.repository,
+            build_info=self.build_info,
+            digests=self.digests,
             package_infos=self.package_infos,
             applications=self.applications,
             misconfigurations=self.misconfigurations,

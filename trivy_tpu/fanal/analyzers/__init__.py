@@ -3,6 +3,7 @@
 
 from trivy_tpu.fanal.analyzers import (  # noqa: F401
     binary,
+    buildinfo,
     config,
     installed,
     lang,

@@ -163,5 +163,32 @@ class PomAnalyzer(Analyzer):
         )
 
 
+class WordPressAnalyzer(Analyzer):
+    """WordPress core version from wp-includes/version.php (ref:
+    pkg/dependency/parser/frameworks/wordpress)."""
+
+    type = AnalyzerType.WORDPRESS
+    version = 1
+
+    def __init__(self, options):
+        pass
+
+    def required(self, file_path: str, info) -> bool:
+        return file_path.endswith("wp-includes/version.php")
+
+    def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
+        pkgs = P.parse_wordpress_version(inp.content, inp.file_path)
+        if not pkgs:
+            return None
+        return AnalysisResult(
+            applications=[
+                Application(
+                    type="wordpress", file_path=inp.file_path, packages=pkgs
+                )
+            ]
+        )
+
+
+register_analyzer(WordPressAnalyzer)
 register_analyzer(JarAnalyzer)
 register_analyzer(PomAnalyzer)

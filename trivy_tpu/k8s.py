@@ -111,14 +111,21 @@ def load_cluster(context: str | None = None) -> list[dict]:
         raise RuntimeError(f"kubectl produced invalid JSON: {e}") from e
 
 
-def scan_workloads(docs: list[dict], scanner: MisconfScanner | None = None):
-    """Per-resource misconfiguration rows:
-    [{namespace, kind, name, severities{...}, failures[...]}]."""
+def scan_workloads(docs: list[dict], scanner: MisconfScanner | None = None,
+                   secret_scanner=None):
+    """Per-resource rows carrying both scanner classes the manifest itself
+    can produce (ref: the k8s report aggregates every class per resource):
+    [{namespace, kind, name, severities{...}, failures[...], secrets[...]}].
+    Image vulnerabilities ride the separate --scan-images rows."""
     import yaml
 
     from trivy_tpu import k8s_node
 
     scanner = scanner or MisconfScanner(ScannerOption(file_types=["kubernetes"]))
+    if secret_scanner is None:
+        from trivy_tpu.secret.engine import SecretScanner
+
+        secret_scanner = SecretScanner()
     rows = []
     for doc in docs:
         kind = doc.get("kind", "")
@@ -146,15 +153,21 @@ def scan_workloads(docs: list[dict], scanner: MisconfScanner | None = None):
         mc = scanner.scan_file(f"{namespace}/{kind}/{name}.yaml", text.encode(),
                                "kubernetes")
         failures = list(mc.failures) if mc else []
+        secret = secret_scanner.scan_bytes(
+            f"{namespace}/{kind}/{name}.yaml", text.encode()
+        )
         sev = {s: 0 for s in SEVERITIES}
         for f in failures:
             sev[f.severity if f.severity in sev else "UNKNOWN"] += 1
+        for sf in secret.findings:
+            sev[sf.severity if sf.severity in sev else "UNKNOWN"] += 1
         rows.append({
             "namespace": namespace,
             "kind": kind,
             "name": name,
             "severities": sev,
             "failures": failures,
+            "secrets": list(secret.findings),
         })
     rows.sort(key=lambda r: (r["namespace"], r["kind"], r["name"]))
     return rows
@@ -171,6 +184,7 @@ def write_summary(rows: list[dict], out, fmt: str = "table",
                     "Name": r["name"],
                     "Summary": r["severities"],
                     "Misconfigurations": [f.to_dict() for f in r["failures"]],
+                    "Secrets": [s.to_dict() for s in r.get("secrets", [])],
                 }
                 for r in rows
             ],

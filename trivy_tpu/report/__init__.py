@@ -33,5 +33,9 @@ def write(report: Report, fmt: str = "table", output=None, **kw) -> None:
         from trivy_tpu.report.template import write_template
 
         write_template(report, out, **kw)
+    elif fmt == "cosign-vuln":
+        from trivy_tpu.report.predicate import write_cosign_vuln
+
+        write_cosign_vuln(report, out, **kw)
     else:
         raise ValueError(f"unknown format: {fmt}")

@@ -560,6 +560,11 @@ class BlobInfo:
     schema_version: int = SCHEMA_VERSION
     os: OS | None = None
     repository: dict[str, str] | None = None  # {"Family":..., "Release":...}
+    # Red Hat build metadata: {"ContentSets": [...]} or {"Nvr":..., "Arch":...}
+    build_info: dict | None = None
+    # executable sha256 digests for signature/rekor lookups (the lookup
+    # itself is the env-blocked seam; collection matches the reference)
+    digests: dict[str, str] = field(default_factory=dict)
     package_infos: list[PackageInfo] = field(default_factory=list)
     applications: list[Application] = field(default_factory=list)
     misconfigurations: list[Misconfiguration] = field(default_factory=list)
@@ -577,6 +582,8 @@ class BlobInfo:
             "SchemaVersion": self.schema_version,
             "OS": self.os.to_dict() if self.os else None,
             "Repository": self.repository,
+            "BuildInfo": self.build_info,
+            "Digests": dict(self.digests) or None,
             "PackageInfos": [p.to_dict() for p in self.package_infos],
             "Applications": [a.to_dict() for a in self.applications],
             "Misconfigurations": [m.to_dict() for m in self.misconfigurations],
@@ -595,6 +602,8 @@ class BlobInfo:
             schema_version=d.get("SchemaVersion", SCHEMA_VERSION),
             os=OS.from_dict(d["OS"]) if d.get("OS") else None,
             repository=d.get("Repository"),
+            build_info=d.get("BuildInfo"),
+            digests=dict(d.get("Digests") or {}),
             package_infos=[PackageInfo.from_dict(x) for x in d.get("PackageInfos", []) or []],
             applications=[Application.from_dict(x) for x in d.get("Applications", []) or []],
             misconfigurations=[
