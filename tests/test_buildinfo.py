@@ -62,7 +62,10 @@ def test_apk_repositories():
 
 
 def test_executable_digests():
-    a = ExecutableAnalyzer(None)
+    class Opt:
+        extra = {"executable_digests": True}
+
+    a = ExecutableAnalyzer(Opt())
     elf = b"\x7fELF" + b"\0" * 64
     info = FileInfo(size=len(elf), mode=0o755)
     assert a.required("usr/bin/tool", info)
@@ -72,6 +75,37 @@ def test_executable_digests():
     assert r.digests == {"usr/bin/tool": want}
     # non-binary executable (shell script): skipped
     assert a.analyze(_inp("usr/bin/x.sh", b"#!/bin/sh\n", mode=0o755)) is None
+    # opt-in: disabled by default (hashing every executable is pure cost
+    # until a digest consumer is reachable)
+    class Off:
+        extra = {}
+
+    assert not ExecutableAnalyzer(Off()).required("usr/bin/tool", info)
+
+
+def test_apk_repositories_skips_comments():
+    a = ApkRepoAnalyzer(None)
+    r = a.analyze(_inp("etc/apk/repositories",
+                       b"https://dl-cdn.alpinelinux.org/alpine/v3.18/main\n"
+                       b"#https://dl-cdn.alpinelinux.org/alpine/edge/testing\n"))
+    assert r.repository == {"Family": "alpine", "Release": "3.18"}
+
+
+def test_build_info_reaches_artifact_detail(tmp_path):
+    from trivy_tpu.fanal.applier import apply_layers
+    from trivy_tpu.types import BlobInfo
+
+    blobs = [
+        BlobInfo(build_info={"ContentSets": ["rhel-8-baseos"]}, diff_id="a"),
+        BlobInfo(build_info={"Nvr": "ubi8-8.5-204", "Arch": "x86_64"},
+                 digests={"usr/bin/x": "sha256:ab"}, diff_id="b"),
+    ]
+    detail = apply_layers(blobs)
+    assert detail.build_info == {
+        "ContentSets": ["rhel-8-baseos"], "Nvr": "ubi8-8.5-204",
+        "Arch": "x86_64",
+    }
+    assert detail.digests == {"usr/bin/x": "sha256:ab"}
 
 
 def test_blobinfo_roundtrip_buildinfo_digests():

@@ -141,6 +141,9 @@ class ApkRepoAnalyzer(Analyzer):
         family = ""
         release = ""
         for line in inp.content.decode("utf-8", "replace").splitlines():
+            line = line.strip()
+            if line.startswith("#"):  # a commented-out edge repo must not
+                continue              # flip the advisory stream
             m = _APK_REPO_RE.search(line)
             if not m:
                 continue
@@ -176,16 +179,22 @@ _MACHO_MAGICS = (b"\xfe\xed\xfa\xce", b"\xfe\xed\xfa\xcf",
 class ExecutableAnalyzer(Analyzer):
     """sha256 digests of executable binaries (the reference feeds these to
     rekor/signature lookups — that consumer is env-blocked here, the
-    collection is not)."""
+    collection is not).
+
+    Opt-in (``analyzer_extra["executable_digests"]``): hashing every
+    executable reads each one in full, which is pure cost until a digest
+    consumer (rekor) is reachable."""
 
     type = AnalyzerType.EXECUTABLE
     version = 1
 
     def __init__(self, options):
-        pass
+        self._enabled = bool(
+            getattr(options, "extra", {}).get("executable_digests")
+        )
 
     def required(self, file_path: str, info) -> bool:
-        return bool(getattr(info, "mode", 0) & 0o111)
+        return self._enabled and bool(getattr(info, "mode", 0) & 0o111)
 
     def analyze(self, inp: AnalysisInput) -> AnalysisResult | None:
         head = inp.content[:4]

@@ -50,6 +50,12 @@ def apply_layers(blobs: list[BlobInfo]) -> ArtifactDetail:
             detail.os = detail.os.merge(blob.os) if detail.os else blob.os
         if blob.repository is not None:
             detail.repository = blob.repository
+        if blob.build_info is not None:
+            merged = dict(detail.build_info or {})
+            merged.update(blob.build_info)
+            detail.build_info = merged
+        if blob.digests:
+            detail.digests.update(blob.digests)
 
         for pi in blob.package_infos:
             for p in pi.packages:

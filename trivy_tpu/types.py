@@ -669,6 +669,8 @@ class ArtifactDetail:
 
     os: OS | None = None
     repository: dict[str, str] | None = None
+    build_info: dict | None = None
+    digests: dict[str, str] = field(default_factory=dict)
     packages: list[Package] = field(default_factory=list)
     applications: list[Application] = field(default_factory=list)
     misconfigurations: list[Misconfiguration] = field(default_factory=list)
