@@ -416,3 +416,38 @@ def test_pom_root_edges(tmp_path):
     assert root.depends_on == [
         "com.fasterxml.jackson.core:jackson-databind@2.15.2"
     ]
+
+
+def test_podfile_lock_edges():
+    lock = b"""PODS:
+  - Alamofire (5.4.3)
+  - AlamofireImage (4.2.0):
+    - Alamofire (~> 5.4)
+  - Firebase/Core (8.0.0):
+    - FirebaseCore (= 8.0.0)
+  - FirebaseCore (8.0.0)
+
+DEPENDENCIES:
+  - AlamofireImage
+"""
+    pkgs = by_id(parsers.parse_podfile_lock(lock))
+    assert pkgs["AlamofireImage@4.2.0"].depends_on == ["Alamofire@5.4.3"]
+    assert pkgs["Firebase@8.0.0"].depends_on == ["FirebaseCore@8.0.0"]
+
+
+def test_pubspec_relationships():
+    lock = b"""packages:
+  http:
+    dependency: "direct main"
+    version: "1.1.0"
+  async:
+    dependency: transitive
+    version: "2.11.0"
+  lints:
+    dependency: "direct dev"
+    version: "2.1.1"
+"""
+    pkgs = by_id(parsers.parse_pubspec_lock(lock))
+    assert pkgs["http@1.1.0"].relationship == "direct"
+    assert pkgs["async@2.11.0"].relationship == "indirect"
+    assert pkgs["lints@2.1.1"].dev

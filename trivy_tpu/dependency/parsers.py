@@ -603,7 +603,12 @@ def parse_pubspec_lock(content: bytes, path: str = "") -> list[Package]:
         ver = (meta or {}).get("version", "")
         if ver:
             dep_kind = (meta or {}).get("dependency", "")
-            pkgs.append(_pkg(name, ver, indirect="transitive" in dep_kind))
+            indirect = "transitive" in dep_kind
+            pkgs.append(_pkg(
+                name, ver, indirect=indirect,
+                relationship="indirect" if indirect else "direct",
+                dev=dep_kind == "direct dev",
+            ))
     return pkgs
 
 
@@ -611,21 +616,39 @@ def parse_pubspec_lock(content: bytes, path: str = "") -> list[Package]:
 
 
 def parse_podfile_lock(content: bytes, path: str = "") -> list[Package]:
+    """Podfile.lock PODS entries incl. the dependency edges each pod lists
+    as its nested items (`- Pod (1.0):\\n  - Dep (~> 2.0)` — ref:
+    parser/swift/cocoapods), with subspecs collapsed onto the base pod."""
     import yaml
 
     doc = yaml.safe_load(content) or {}
-    pkgs = []
+    versions: dict[str, str] = {}  # base pod name -> version
+    raw_edges: dict[str, set] = {}
+
+    def pod_name(s: str) -> tuple[str, str]:
+        m = re.match(r"^(\S+)(?: \(([^)]+)\))?$", str(s))
+        return (m.group(1).split("/")[0], m.group(2) or "") if m else ("", "")
+
     for entry in doc.get("PODS") or []:
+        deps: list[str] = []
         if isinstance(entry, dict):
-            entry = next(iter(entry))
-        m = re.match(r"^(\S+) \(([^)]+)\)$", str(entry))
-        if m:
-            pkgs.append(_pkg(m.group(1).split("/")[0], m.group(2)))
-    # dedup subspecs
-    seen = {}
-    for p in pkgs:
-        seen.setdefault((p.name, p.version), p)
-    return [seen[k] for k in sorted(seen)]
+            entry, deps = next(iter(entry.items()))
+        name, ver = pod_name(entry)
+        if not name or not ver:
+            continue
+        versions.setdefault(name, ver)
+        for d in deps or []:
+            dep_base, _ = pod_name(d)
+            if dep_base and dep_base != name:
+                raw_edges.setdefault(name, set()).add(dep_base)
+    pkgs = []
+    for name in sorted(versions):
+        p = _pkg(name, versions[name])
+        p.depends_on = sorted(
+            f"{d}@{versions[d]}" for d in raw_edges.get(name, ()) if d in versions
+        )
+        pkgs.append(p)
+    return pkgs
 
 
 # --- Package.resolved (swift, ref: parser/swift/swift) ----------------------

@@ -95,6 +95,34 @@ class MisconfScanner:
         if tf_files:
             out.extend(self._scan_terraform(tf_files))
         if helm_files:
+            # charts are more than their templates: Chart.yaml/values.yaml
+            # carry no {{ }} so they type as plain yaml — hand every
+            # yaml-ish sibling to the renderer, which groups files by
+            # chart root and ignores the rest (the reference feeds the
+            # whole chart directory to the helm SDK the same way)
+            import os.path as _p
+
+            for path, ftype, content in per_file:
+                if ftype in (
+                    detection.FILE_TYPE_YAML, detection.FILE_TYPE_JSON,
+                    detection.FILE_TYPE_KUBERNETES,
+                ) and path not in helm_files:
+                    helm_files[path] = content
+            roots = {
+                _p.dirname(p) for p in helm_files
+                if _p.basename(p) == "Chart.yaml"
+            }
+            # chart templates render through helm; scanning the raw
+            # template text as standalone kubernetes too would double-count
+            per_file = [
+                (path, ftype, content)
+                for path, ftype, content in per_file
+                if not any(
+                    path.startswith((_p.join(r, "templates") + "/") if r
+                                    else "templates/")
+                    for r in roots
+                )
+            ]
             out.extend(self._scan_helm(helm_files))
         for path, ftype, content in per_file:
             mc = self.scan_file(path, content, ftype)
