@@ -192,3 +192,67 @@ def test_repo_command_local_bare_url(tmp_path):
     doc = json.loads(p.stdout)
     ids = [s["RuleID"] for r in doc["Results"] for s in r.get("Secrets", [])]
     assert ids == ["aws-access-key-id"]
+
+
+MIT_LICENSE = """\
+MIT License
+
+Copyright (c) 2024 Example Author
+
+Permission is hereby granted, free of charge, to any person obtaining a copy
+of this software and associated documentation files (the "Software"), to deal
+in the Software without restriction.
+
+The above copyright notice and this permission notice shall be included in
+all copies or substantial portions of the Software.
+
+THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND, EXPRESS OR
+IMPLIED.
+"""
+
+
+def test_license_scanner_classifies_loose_license_without_full_flag(tmp_path):
+    """VERDICT live-scan regression: `--scanners license` alone must
+    classify a loose MIT LICENSE file — only header/full-content scanning
+    is the --license-full opt-in (ref: run.go:436-440)."""
+    (tmp_path / "LICENSE").write_text(MIT_LICENSE)
+    (tmp_path / "util.c").write_text(
+        "/* " + MIT_LICENSE.replace("\n", "\n * ") + " */\nint main;\n"
+    )
+    p = run_cli(
+        "fs", "--scanners", "license", "--backend", "cpu", "--format", "json",
+        "--cache-dir", str(tmp_path / "cache"), str(tmp_path),
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    lics = [
+        lic
+        for r in doc["Results"]
+        if r.get("Class") == "license-file"
+        for lic in r.get("Licenses", [])
+    ]
+    by_path = {lic["FilePath"]: lic for lic in lics}
+    assert "LICENSE" in by_path, doc["Results"]
+    assert by_path["LICENSE"]["Name"] == "MIT"
+    # header classification stays behind --license-full
+    assert "util.c" not in by_path
+
+
+def test_license_full_flag_still_enables_headers(tmp_path):
+    (tmp_path / "util.c").write_text(
+        "/* " + MIT_LICENSE.replace("\n", "\n * ") + " */\nint main;\n"
+    )
+    p = run_cli(
+        "fs", "--scanners", "license", "--license-full", "--backend", "cpu",
+        "--format", "json", "--cache-dir", str(tmp_path / "cache"),
+        str(tmp_path),
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout)
+    paths = {
+        lic["FilePath"]
+        for r in doc["Results"]
+        if r.get("Class") == "license-file"
+        for lic in r.get("Licenses", [])
+    }
+    assert "util.c" in paths

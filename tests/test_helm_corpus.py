@@ -163,3 +163,56 @@ def test_unsupported_sprig_tail_degrades_with_message(caplog):
     # unknown function: the file is skipped with a warning, not a crash
     rendered = render_charts(files)
     assert not any(p.endswith("bad.yaml") for p in rendered) or True
+
+
+def test_chart_root_files_not_double_scanned():
+    """Regression: chart-root files (values.yaml, Chart.yaml) and
+    chart-adjacent manifests belong to the chart — the standalone per-file
+    pass must skip everything under a detected chart root, while
+    unrelated manifests outside the chart still scan standalone."""
+    files = dict(_chart_files())
+    privileged_pod = (
+        b"apiVersion: v1\nkind: Pod\nmetadata:\n  name: p\nspec:\n"
+        b"  containers:\n    - name: c\n      image: busybox\n"
+        b"      securityContext:\n        privileged: true\n"
+    )
+    files["webapp/extra-pod.yaml"] = privileged_pod  # chart-adjacent
+    files["standalone-pod.yaml"] = privileged_pod  # outside the chart
+    # non-yaml types never enter the helm lane: a Dockerfile inside the
+    # chart dir must keep its standalone scan
+    files["webapp/Dockerfile"] = b"FROM busybox\nUSER root\nCMD [\"sh\"]\n"
+    # k8s manifests ship as JSON too — chart-owned JSON must flow through
+    # the helm lane, not vanish
+    files["webapp/extra-pod.json"] = (
+        b'{"apiVersion": "v1", "kind": "Pod", "metadata": {"name": "pj"},'
+        b' "spec": {"containers": [{"name": "c", "image": "busybox",'
+        b' "securityContext": {"privileged": true}}]}}'
+    )
+    out = MisconfScanner(ScannerOption()).scan_files(list(files.items()))
+    by_file = {}
+    for mc in out:
+        by_file.setdefault(mc.file_path, []).append(mc)
+    # chart config never produces standalone results
+    assert "webapp/values.yaml" not in by_file
+    assert "webapp/Chart.yaml" not in by_file
+    # the chart-adjacent manifest is scanned exactly once, via the helm
+    # lane (helm installs non-template chart yaml verbatim) — not again
+    # standalone
+    extra = by_file["webapp/extra-pod.yaml"]
+    assert len(extra) == 1 and extra[0].file_type == "helm"
+    assert "KSV017" in {f.id for f in extra[0].failures}
+    extra_json = by_file["webapp/extra-pod.json"]
+    assert len(extra_json) == 1 and extra_json[0].file_type == "helm"
+    assert "KSV017" in {f.id for f in extra_json[0].failures}
+    # the chart's own findings come exactly once, via the rendered lane
+    dep = by_file["webapp/templates/deployment.yaml"]
+    assert len(dep) == 1 and dep[0].file_type == "helm"
+    assert "KSV017" in {f.id for f in dep[0].failures}
+    # the unrelated manifest still scans standalone
+    assert "KSV017" in {
+        f.id for mc in by_file["standalone-pod.yaml"] for f in mc.failures
+    }
+    # the Dockerfile under the chart root still scans standalone
+    assert any(
+        f.id for mc in by_file.get("webapp/Dockerfile", []) for f in mc.failures
+    ), sorted(by_file)

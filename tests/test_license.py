@@ -268,3 +268,117 @@ class TestFullTextClassification:
             assert [(f.name, f.confidence) for f in a] == [
                 (f.name, f.confidence) for f in b
             ]
+
+
+class TestDeviceScoring:
+    """Tentpole regressions: the device n-gram scoring path
+    (ops/ngram_score — sorted int32 gram rows vs the HBM-resident corpus
+    table) must match the host oracle finding-for-finding."""
+
+    @staticmethod
+    def _mixed_texts():
+        import numpy as np
+
+        from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
+
+        rng = np.random.default_rng(7)
+        texts = [FULL_TEXTS[k] for k in sorted(FULL_TEXTS)]
+        texts += [
+            "no license content at all",
+            "x consortium mentioned in passing",
+            "Server Side Public License VERSION 1, OCTOBER 16, 2018",
+            "",
+            "short",
+            "permission is hereby granted, free of charge, to any person "
+            "obtaining a copy of this software.",
+        ]
+        for _ in range(40):  # source-like noise
+            texts.append(
+                " ".join(
+                    "".join(chr(c) for c in rng.integers(97, 123, size=7))
+                    for _ in range(300)
+                )
+            )
+        return texts
+
+    def test_device_batch_matches_host(self):
+        texts = self._mixed_texts()
+        host = LicenseClassifier(backend="cpu").classify_batch(texts)
+        dev = LicenseClassifier(backend="device").classify_batch(texts)
+        for i, (a, b) in enumerate(zip(host, dev)):
+            assert [(f.name, f.confidence) for f in a] == [
+                (f.name, f.confidence) for f in b
+            ], f"text {i}"
+
+    def test_device_corpus_resident_across_instances(self):
+        # the corpus table uploads once per process; fresh classifier
+        # instances (one per analyzer finalize) reuse the same buffers
+        texts = self._mixed_texts()[:16]
+        a = LicenseClassifier(backend="device")
+        b = LicenseClassifier(backend="device")
+        a.classify_batch(texts)
+        buffers = a._scorer.corpus_device
+        b.classify_batch(texts)
+        assert b._scorer is a._scorer
+        assert a._scorer.corpus_device is buffers  # no re-upload
+        a.classify_batch(texts)
+        assert a._scorer.corpus_device is buffers
+
+    def test_fold32_preserves_matches_and_reserves_pad(self):
+        import numpy as np
+
+        from trivy_tpu.ops import ngram_score as ng
+
+        k = np.array(
+            [0, -1, 2**63 - 1, -(2**63), 12345, int(ng.PAD_KEY)],
+            dtype=np.int64,
+        )
+        f1, f2 = ng.fold32(k), ng.fold32(k.copy())
+        assert (f1 == f2).all()  # deterministic: equality survives the fold
+        assert (f1 != ng.PAD_KEY).all()  # sentinel reserved for padding
+
+    def test_pack_gram_rows_sorted_unique_rows(self):
+        import numpy as np
+
+        from trivy_tpu.ops import ngram_score as ng
+
+        keys = np.array([5, 3, 3, -9, 7, 7, 7], dtype=np.int32)
+        tids = np.array([0, 0, 0, 1, 1, 1, 1], dtype=np.int64)
+        groups, overflow = ng.pack_gram_rows(keys, tids, 3, min_row=4)
+        assert overflow == []
+        assert len(groups) == 1
+        rows, tis = groups[0]
+        assert tis.tolist() == [0, 1]  # text 2 has no grams
+        assert rows[0, :2].tolist() == [3, 5]  # sorted, deduped
+        assert rows[1, :2].tolist() == [-9, 7]
+        assert (rows[:, 2:] == ng.PAD_KEY).all()
+
+    def test_pack_gram_rows_overflow_to_host(self):
+        import numpy as np
+
+        from trivy_tpu.ops import ngram_score as ng
+
+        keys = np.arange(20, dtype=np.int32)
+        tids = np.zeros(20, dtype=np.int64)
+        groups, overflow = ng.pack_gram_rows(
+            keys, tids, 1, max_row=16, min_row=4
+        )
+        assert overflow == [0] and groups == []
+
+    def test_device_matches_host_at_custom_confidence(self):
+        # partial-credit scoring must agree between engines when the
+        # threshold admits sub-1.0 confidences
+        text = (
+            "Permission is hereby granted, free of charge, to any person "
+            "obtaining a copy of this software. "
+            "The above copyright notice and this permission notice shall be "
+            "reproduced in all copies. "
+            'THE SOFTWARE IS PROVIDED "AS IS", WITHOUT WARRANTY OF ANY KIND.'
+        )
+        texts = [text] * 8 + self._mixed_texts()[:8]
+        host = LicenseClassifier(backend="cpu", confidence=0.5)
+        dev = LicenseClassifier(backend="device", confidence=0.5)
+        for a, b in zip(host.classify_batch(texts), dev.classify_batch(texts)):
+            assert [(f.name, f.confidence) for f in a] == [
+                (f.name, f.confidence) for f in b
+            ]

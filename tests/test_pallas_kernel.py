@@ -83,3 +83,25 @@ def test_all_host_lane_ruleset_builds_noop_kernel():
     out = np.asarray(fn(np.zeros((8, 1024), dtype=np.uint8)))
     assert out.shape == (8, compiled.num_rules)
     assert not out.any()
+
+
+def test_all_anchored_ruleset_builds():
+    """Regression: a ruleset with anchored variants but zero keywords used
+    to crash kernel construction (`per=0` fed `range(0, 0, 0)`)."""
+    from trivy_tpu.ops.match_pallas import build_match_fn_pallas
+    from trivy_tpu.secret.rules import Rule
+    from trivy_tpu.types import Severity
+
+    rules = [
+        Rule(
+            id="anchored-only",
+            category="test",
+            title="anchored literal, no keywords",
+            severity=Severity.HIGH,
+            regex=r"AKIA[0-9A-Z]{16}",
+        )
+    ]
+    compiled = compile_rules(rules)
+    assert compiled.keywords == [] and compiled.variants
+    fn = build_match_fn_pallas(compiled, 1024)  # must not raise at build
+    assert callable(fn)

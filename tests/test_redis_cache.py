@@ -188,3 +188,57 @@ def test_scan_through_redis_cache(fake_redis, tmp_path):
     assert report.artifact_name
     assert any(k.startswith("fanal::blob::") for k in fake_redis.data)
     cache.close()
+
+
+def test_rediss_verifies_certificates_by_default(fake_redis, monkeypatch):
+    """Regression: rediss:// without --redis-ca used to set CERT_NONE
+    (silent MITM surface on the shared scan cache). The default context
+    must keep system-root verification; only the explicit insecure flag
+    may drop it."""
+    import ssl as ssl_mod
+
+    from trivy_tpu.cache import redis as redis_mod
+
+    created = []
+
+    class _Ctx:
+        def __init__(self):
+            self.check_hostname = True
+            self.verify_mode = ssl_mod.CERT_REQUIRED
+            self.cafile = None
+            self.cert_chain = None
+
+        def load_cert_chain(self, cert, key=None):
+            self.cert_chain = (cert, key)
+
+        def wrap_socket(self, sock, server_hostname=None):
+            return sock  # fake server speaks plain TCP
+
+    def fake_create(cafile=None):
+        ctx = _Ctx()
+        ctx.cafile = cafile
+        created.append(ctx)
+        return ctx
+
+    monkeypatch.setattr(redis_mod.ssl, "create_default_context", fake_create)
+
+    cache = redis_mod.RedisCache(f"rediss://127.0.0.1:{fake_redis.port}")
+    cache.close()
+    assert created[-1].cafile is None  # system trust roots
+    assert created[-1].check_hostname is True
+    assert created[-1].verify_mode == ssl_mod.CERT_REQUIRED
+
+    cache = redis_mod.RedisCache(
+        f"rediss://127.0.0.1:{fake_redis.port}", insecure_skip_verify=True
+    )
+    cache.close()
+    assert created[-1].check_hostname is False
+    assert created[-1].verify_mode == ssl_mod.CERT_NONE
+
+    # --redis-ca still routes through the custom CA file
+    cache = redis_mod.RedisCache(
+        f"rediss://127.0.0.1:{fake_redis.port}", ca_cert="/tmp/ca.pem"
+    )
+    cache.close()
+    assert created[-1].cafile == "/tmp/ca.pem"
+    assert created[-1].verify_mode == ssl_mod.CERT_REQUIRED

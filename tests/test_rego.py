@@ -403,3 +403,20 @@ def test_rego_check_unsupported_construct_errors(tmp_path):
     p.write_text("package user.x\ndeny[m] { every v in input.xs { v } ; m := \"x\" }\n")
     with pytest.raises(CustomCheckError, match="every"):
         load_custom_checks([str(p)])
+
+
+@pytest.mark.parametrize("src,inp,want", [
+    # regression: `n-1` / `count(x)-1` used to tokenize the minus into the
+    # number literal, silently evaluating `n` and `-1` as separate terms
+    ("package t\nr { input.n-1 == 2 }", {"n": 3}, True),
+    ("package t\nr { count(input.xs)-1 == 1 }", {"xs": [1, 2]}, True),
+    ("package t\nr { count(input.xs) - 1 == 1 }", {"xs": [1, 2]}, True),
+    ("package t\nr { input.xs[count(input.xs)-1] == 9 }", {"xs": [1, 9]}, True),
+    # unary minus still yields negative literals
+    ("package t\nr { x := -5\n x + 6 == 1 }", {}, True),
+    ("package t\nr { -3 + 4 == 1 }", {}, True),
+    ("package t\nr { input.x == -2 }", {"x": -2}, True),
+    ("package t\nr { input.n - 1 == 2 }", {"n": 99}, None),  # undefined
+])
+def test_minus_tokenization(src, inp, want):
+    assert parse_module(src).eval_rule("r", input=inp) is want

@@ -91,6 +91,7 @@ class RedisCache:
         client_cert: str = "",
         client_key: str = "",
         timeout: float = 10.0,
+        insecure_skip_verify: bool = False,
     ):
         u = urllib.parse.urlparse(url)
         if u.scheme not in ("redis", "rediss"):
@@ -100,12 +101,16 @@ class RedisCache:
         port = u.port or 6379
         sock = socket.create_connection((host, port), timeout=timeout)
         if u.scheme == "rediss" or ca_cert or client_cert:
+            # default context = system trust roots + hostname verification;
+            # a shared scan cache carries poisoning risk, so certificate
+            # checks are only dropped behind the explicit insecure flag
+            # (never silently, as rediss:// without --redis-ca once did)
             ctx = ssl.create_default_context(
                 cafile=ca_cert or None
             )
             if client_cert:
                 ctx.load_cert_chain(client_cert, client_key or None)
-            if not ca_cert:
+            if insecure_skip_verify:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
             sock = ctx.wrap_socket(sock, server_hostname=host)

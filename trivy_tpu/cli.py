@@ -41,6 +41,10 @@ def global_flags() -> FlagGroup:
                  help="redis TLS client certificate path"),
             Flag("redis-key", default=None, config_name="cache.redis.key",
                  help="redis TLS client key path"),
+            Flag("redis-insecure", default=False, value_type=bool,
+                 config_name="cache.redis.insecure",
+                 help="skip redis TLS certificate verification (rediss:// "
+                      "verifies against system roots by default)"),
             Flag("config", default=None, help="config file path", short="c"),
             Flag("timeout", default=300, value_type=int, config_name="timeout",
                  help="scan timeout seconds (ref default 5m)"),

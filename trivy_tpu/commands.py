@@ -26,6 +26,7 @@ def _make_cache(opts):
             "ca_cert": opts.get("redis_ca") or "",
             "client_cert": opts.get("redis_cert") or "",
             "client_key": opts.get("redis_key") or "",
+            "insecure_skip_verify": bool(opts.get("redis_insecure")),
         }
     return new_cache(backend, opts.get("cache_dir"), **kwargs)
 
@@ -46,8 +47,14 @@ def _artifact_option(ns, opts):
 
     if "secret" not in scanners:
         disabled.append(AnalyzerType.SECRET)
-    if "license" not in scanners or not opts.get("license_full"):
+    # loose LICENSE/COPYING files classify whenever the license scanner is
+    # on; only header/full-content classification is the expensive opt-in
+    # behind --license-full (ref: run.go:436-440 disables TypeLicenseFile
+    # solely when the scanner is off)
+    if "license" not in scanners:
         disabled.append(AnalyzerType.LICENSE_FILE)
+        disabled.append(AnalyzerType.LICENSE_HEADER)
+    elif not opts.get("license_full"):
         disabled.append(AnalyzerType.LICENSE_HEADER)
     if "misconfig" not in scanners:
         disabled.append(AnalyzerType.CONFIG)

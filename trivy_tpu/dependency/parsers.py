@@ -338,12 +338,26 @@ def parse_pipfile_lock(content: bytes, path: str = "") -> list[Package]:
 # --- poetry.lock / uv.lock / Cargo.lock (TOML [[package]]) ------------------
 
 
+def _tomllib():
+    """stdlib tomllib (3.11+) with fallbacks for 3.10 hosts: the
+    standalone tomli package first, pip's vendored copy as a last
+    resort (pip-less slim interpreters won't have the latter)."""
+    try:
+        import tomllib
+    except ImportError:
+        try:
+            import tomli as tomllib
+        except ImportError:
+            from pip._vendor import tomli as tomllib
+    return tomllib
+
+
 def _parse_toml_packages(content: bytes, dev_groups: bool = False) -> list[Package]:
     """Lockfiles of [[package]] entries (poetry/uv/cargo), with dependency
     edges resolved by name against the lock's own entries (versions are
     pinned, so name -> version is unambiguous except for multi-version
     cargo graphs, where an exact "name version" spec disambiguates)."""
-    import tomllib
+    tomllib = _tomllib()
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
     entries = doc.get("package", []) or []
@@ -690,7 +704,7 @@ def parse_dotnet_deps(content: bytes, path: str = "") -> list[Package]:
 def parse_julia_manifest(content: bytes, path: str = "") -> list[Package]:
     """Julia package manifest: [[deps.Name]] entries with uuid/version and
     name-resolved dependency edges (stdlib entries carry no version)."""
-    import tomllib
+    tomllib = _tomllib()
 
     doc = tomllib.loads(content.decode("utf-8", "replace"))
     deps_tbl = doc.get("deps", doc)  # format 2 nests under [deps]; 1 is flat

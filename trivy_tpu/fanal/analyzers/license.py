@@ -1,16 +1,19 @@
 """License-file analyzers (ref: pkg/fanal/analyzer/licensing/license.go).
 
-Two batched analyzers behind ``--license-full``:
+Two batched analyzers:
 
 - LICENSE_FILE: canonical license files (LICENSE/COPYING/NOTICE and
-  variants) — classified whole.
-- LICENSE_HEADER: source-file headers — the first few KiB of source files,
-  classified the same way.
+  variants) — classified whole, whenever the license scanner is enabled
+  (reference default behavior, run.go:436-440).
+- LICENSE_HEADER: source-file headers — the first few KiB of source
+  files; the expensive opt-in behind ``--license-full``.
 
 Both collect candidates during the walk and classify them in one
 device-batched ``classify_batch`` call in finalize (the TPU replacement
 for the reference's mutex-guarded per-file licenseclassifier calls,
-ref: pkg/licensing/classifier.go:17-54).
+ref: pkg/licensing/classifier.go:17-54); on accelerators the batch runs
+through the sharded n-gram scoring kernel (ops/ngram_score) with the
+corpus table resident on device across scans.
 """
 
 from __future__ import annotations

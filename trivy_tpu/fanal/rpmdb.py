@@ -385,9 +385,25 @@ def build_sqlite_db(blobs: list[bytes]) -> bytes:
     for i, b in enumerate(blobs, 1):
         con.execute("INSERT INTO Packages VALUES (?, ?)", (i, b))
     con.commit()
-    out = con.serialize()
+    if hasattr(con, "serialize"):  # 3.11+
+        out = bytes(con.serialize())
+    else:
+        import os
+        import tempfile
+
+        fd, path = tempfile.mkstemp(suffix=".sqlite")
+        os.close(fd)
+        try:
+            dst = sqlite3.connect(path)
+            with dst:
+                con.backup(dst)
+            dst.close()
+            with open(path, "rb") as f:
+                out = f.read()
+        finally:
+            os.unlink(path)
     con.close()
-    return bytes(out)
+    return out
 
 
 def build_bdb(blobs: list[bytes], pagesize: int = 4096,

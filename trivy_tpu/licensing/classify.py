@@ -4,17 +4,27 @@ The reference wraps google/licenseclassifier v2 (n-gram similarity against
 an SPDX corpus) behind a mutex because it is not thread-safe (ref:
 pkg/licensing/classifier.go:17-54). Here classification is word-n-gram
 similarity against normalized full license texts (corpus_texts) plus a
-phrase lane for headers/abbreviated notices, with candidate gating by a
-vectorized inverted gram index — a sparse-lookup problem that lives in
-host cache, deliberately NOT the byte-stream device kernel: shipping whole
-file bytes across the host→device link to find ~0.1% candidate hits wastes
-exactly the bandwidth the secret scanner needs (the device remains the
-engine for streaming byte matching; an explicit ``backend="pallas"/"xla"``
-still routes gating through the shared literal-match kernel for
-device-resident pipelines).
+phrase lane for headers/abbreviated notices.
+
+Two engines share one scoring model:
+
+- **host path** (``backend="cpu"``, and the oracle for parity tests):
+  candidate gating by a vectorized inverted gram index + per-candidate
+  numpy scoring.
+- **device path** (default on accelerators; ``backend="device"`` forces
+  it anywhere): texts are tokenized and hashed host-side into sorted
+  int32 gram rows — only those rows cross the host→device link, never
+  file bytes — and scored against the SPDX corpus-fingerprint table on
+  device (``ops/ngram_score.py``), sharded over the mesh 'model' axis
+  with the table HBM-resident across scans (PAPER.md §7). Dispatches
+  ride the same bucket-ladder/async-pipeline discipline as
+  ``TpuSecretScanner``, so license and secret batches interleave on one
+  device queue instead of serializing.
 """
 
 from __future__ import annotations
+
+import threading
 
 import numpy as np
 
@@ -28,18 +38,45 @@ from trivy_tpu.types import LicenseFinding
 
 _SPDX_URL = "https://spdx.org/licenses/{}.html"
 
-# cap on chunk rows per device dispatch (4096 x 8 KiB = 32 MiB): large
-# inputs split across bounded dispatches instead of one giant padded batch
-MAX_DEVICE_ROWS = 4096
+# cap on gram rows per device dispatch; the bucket ladder pads row counts
+# to powers of two below this so every dispatch shape compiles exactly once
+MAX_DEVICE_ROWS = 1024
+# batches in flight before the oldest result is fetched (mirrors
+# secret.tpu_scanner.PIPELINE_DEPTH)
+DEVICE_PIPELINE_DEPTH = 3
+# below this many texts the fixed dispatch overhead beats the device win
+DEVICE_MIN_TEXTS = 8
+
+# static scoring tables (corpus-derived, confidence-independent), built
+# once per process and shared across classifier instances — the analyzer
+# constructs a classifier per finalize and must not pay the corpus build
+# (or a device corpus re-upload) every scan
+_STATIC_TABLES: dict | None = None
+_STATIC_LOCK = threading.Lock()
+
+
+def _static_scoring_tables() -> dict:
+    global _STATIC_TABLES
+    if _STATIC_TABLES is None:
+        with _STATIC_LOCK:
+            if _STATIC_TABLES is None:
+                _STATIC_TABLES = LicenseClassifier._compute_static_tables()
+    return _STATIC_TABLES
 
 
 class LicenseClassifier:
     """classify(text) -> [LicenseFinding]; classify_batch for many files."""
 
-    def __init__(self, backend: str = "auto", confidence: float = MIN_CONFIDENCE):
+    def __init__(
+        self,
+        backend: str = "auto",
+        confidence: float = MIN_CONFIDENCE,
+        mesh=None,
+    ):
         self.confidence = confidence
         self.backend = backend
-        self._device = None  # (match_fn, compiled-like metadata), built lazily
+        self.mesh = mesh  # optional ('data','model') mesh for sharded scoring
+        self._scorer = None  # ops.ngram_score.DeviceScorer, built lazily
         # flat phrase table: (license, phrase, weight)
         self.licenses = sorted(NORMALIZED_FINGERPRINTS)
         self.phrases: list[tuple[int, str]] = []
@@ -92,56 +129,69 @@ class LicenseClassifier:
     # -- batched path --------------------------------------------------------
 
     def classify_batch(self, texts: list[str]) -> list[list[LicenseFinding]]:
-        if self.backend in ("pallas", "xla") and len(texts) >= 8:
+        if self._use_device(len(texts)):
             return self._classify_batch_device(texts)
         if len(texts) < 4:
             return [self.classify(t) for t in texts]
         return self._classify_batch_host(texts)
 
-    def _classify_batch_host(self, texts: list[str]) -> list[list[LicenseFinding]]:
-        """Whole-batch gating in single numpy passes: every text's bytes are
-        hashed and gated together, so per-file Python work happens only for
-        the (rare) texts that actually gate a candidate license — the shape
-        that makes millions of small source files cheap."""
-        if not hasattr(self, "_gate_keys"):
-            self._build_scoring()
+    def _use_device(self, n_texts: int) -> bool:
+        if self.backend == "cpu" or n_texts < DEVICE_MIN_TEXTS:
+            return False
+        if self.backend in ("device", "pallas", "xla", "tpu"):
+            return True
+        if self.mesh is not None:
+            return True
+        # auto: route to the device kernel only when an accelerator exists
+        # (XLA-CPU scoring beats the host path on nothing but parity tests)
+        import jax
+
+        try:
+            return jax.devices()[0].platform not in ("cpu", "METAL")
+        except Exception:
+            return False
+
+    @classmethod
+    def _batch_hashes(
+        cls, texts: list[str]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """One vectorized pass over every text's bytes at once ->
+        ``(whashes, word_text, keys, gt)``: int64 word hashes + owning
+        text index per word, and int64 gram keys + owning text per gram.
+        Shared by the host batch gate and the device row packer."""
         # concatenate all texts with a separator byte between them
         encoded = [t.encode("latin-1", "replace") for t in texts]
         offsets = np.zeros(len(texts) + 1, dtype=np.int64)
         np.cumsum([len(e) + 1 for e in encoded], out=offsets[1:])
         joined = b"\x00".join(encoded) + b"\x00"
         b = np.frombuffer(joined, dtype=np.uint8)
-        bm = self._LUT[b]
+        bm = cls._LUT[b]
         nz = bm != 0
         prev_nz = np.empty(len(b), dtype=bool)
         prev_nz[0] = False
         prev_nz[1:] = nz[:-1]
         starts = np.nonzero(nz & ~prev_nz)[0]
-        out: list[list[LicenseFinding]] = [[] for _ in texts]
+        empty = np.zeros(0, dtype=np.int64)
         if len(starts) == 0:
-            return out
-        pos = (
-            self._ARANGE[: len(b)]
-            if len(b) <= len(self._ARANGE)
-            else np.arange(len(b), dtype=np.int64)
-        )
+            return empty, empty, empty, empty
+        pos = cls._positions(len(b))
         with np.errstate(over="ignore"):
             s0 = np.add.reduceat(bm, starts)
             np.multiply(bm, pos, out=bm)  # bm no longer needed raw
             s1 = np.add.reduceat(bm, starts)
             s1 -= starts * s0
-            s0 *= self._P1
-            s1 *= self._P2
+            s0 *= cls._P1
+            s1 *= cls._P2
             whashes = s0
             whashes += s1
         word_text = np.searchsorted(offsets, starts, side="right") - 1
-        n = self._NGRAM
+        n = cls._NGRAM
         if len(whashes) >= n:
             m = len(whashes) - n + 1
             with np.errstate(over="ignore"):
                 keys = whashes[:m].copy()
                 for j in range(1, n):
-                    keys *= self._HASH_P
+                    keys *= cls._HASH_P
                     keys += whashes[j : m + j]
             # a gram is valid only when all n words share one text
             gt = word_text[:m]
@@ -150,6 +200,19 @@ class LicenseClassifier:
         else:
             keys = np.zeros(0, dtype=np.int64)
             gt = np.zeros(0, dtype=np.int64)
+        return whashes, word_text, keys, gt
+
+    def _classify_batch_host(self, texts: list[str]) -> list[list[LicenseFinding]]:
+        """Whole-batch gating in single numpy passes: every text's bytes are
+        hashed and gated together, so per-file Python work happens only for
+        the (rare) texts that actually gate a candidate license — the shape
+        that makes millions of small source files cheap."""
+        if not hasattr(self, "_gate_keys"):
+            self._build_scoring()
+        out: list[list[LicenseFinding]] = [[] for _ in texts]
+        whashes, word_text, keys, gt = self._batch_hashes(texts)
+        if len(whashes) == 0:
+            return out
         # global gate: one membership pass for every gram of every text;
         # per-pair hit counts drive pruning (a license whose count cannot
         # reach the confidence floor on either lane is never scored)
@@ -227,78 +290,227 @@ class LicenseClassifier:
         return out
 
     def _classify_batch_device(self, texts: list[str]) -> list[list[LicenseFinding]]:
-        match_fn, chunk_len, overlap = self._build_device()
-        from trivy_tpu.secret.tpu_scanner import chunk_spans
+        """Device n-gram scoring: hash every text's word 5-grams host-side
+        into sorted int32 rows, score all rows against the HBM-resident
+        corpus-fingerprint table (ops/ngram_score), then finalize findings
+        on host for the rare texts where a license's potential confidence
+        clears the threshold.
 
-        rows = []
-        meta = []  # text index per chunk row
-        norms = [normalize(t) for t in texts]
-        for ti, text in enumerate(texts):
-            data = norms[ti].encode("latin-1", "replace")
-            for s in chunk_spans(len(data), chunk_len, overlap):
-                row = np.zeros(chunk_len, dtype=np.uint8)
-                piece = data[s : s + chunk_len]
-                row[: len(piece)] = np.frombuffer(piece, dtype=np.uint8)
-                rows.append(row)
-                meta.append(ti)
-        if not rows:
-            return [[] for _ in texts]
-        # pad each dispatch's row count to a power-of-two bucket so every
-        # shape compiles exactly once; the ladder is capped so huge inputs
-        # split across bounded dispatches instead of one giant batch
-        all_rows = np.stack(rows)
-        hit_parts = []
-        for off in range(0, len(all_rows), MAX_DEVICE_ROWS):
-            part = all_rows[off : off + MAX_DEVICE_ROWS]
-            bucket = 8
-            while bucket < len(part):
-                bucket *= 2
-            batch = np.zeros((bucket, chunk_len), dtype=np.uint8)
-            batch[: len(part)] = part
-            hit_parts.append(np.asarray(match_fn(batch))[: len(part)])
-        hits = np.concatenate(hit_parts)  # [rows, n_phrases]
-        per_text = np.zeros((len(texts), len(self.phrases)), dtype=bool)
-        for row, ti in enumerate(meta):
-            per_text[ti] |= hits[row]
-        return [
-            self._findings(per_text[ti], norms[ti]) for ti in range(len(texts))
-        ]
+        Dispatch follows the ``TpuSecretScanner`` discipline: row counts
+        pad to a power-of-two bucket ladder (every shape compiles once)
+        and a depth-``DEVICE_PIPELINE_DEPTH`` pending queue keeps packing,
+        transfer and kernel execution overlapped, interleaving with any
+        concurrent secret batches on the same device queue.
+        """
+        from collections import deque
 
-    def _build_device(self):
-        if self._device is None:
-            from trivy_tpu.ops.match import build_match_fn
-            from trivy_tpu.secret.device_compile import CompiledRules
+        from trivy_tpu.ops import ngram_score as ng
 
-            compiled = CompiledRules(
-                rule_ids=[f"p{i}" for i in range(len(self.phrases))],
-                classes=np.zeros((1, 256), dtype=bool),
-                variants=[],
-                keywords=[
-                    (i, ph.encode("latin-1", "replace"))
-                    for i, (_li, ph) in enumerate(self.phrases)
-                ],
-                host_rule_ids=[],
-                margin=max(len(ph) for _li, ph in self.phrases) + 1,
-                span=max(len(ph) for _li, ph in self.phrases) + 1,
+        if not hasattr(self, "_gate_keys"):
+            self._build_scoring()
+        scorer = self._device_scorer()
+        out: list[list[LicenseFinding]] = [[] for _ in texts]
+        whashes, word_text, keys, gt = self._batch_hashes(texts)
+        groups, overflow = ng.pack_gram_rows(
+            ng.fold32(keys), gt, len(texts)
+        ) if len(keys) else ([], [])
+        table = scorer.table
+        L = len(self.licenses)
+        # float32 device-accumulation slack: the fold only ever overcounts,
+        # but f32 summation error is two-sided — the kernel's tree-reduce
+        # keeps it ~1e-6 relative even for the largest corpora, so 1e-4
+        # is a conservative band; gate/acceptance comparisons inside it
+        # are settled by the exact host scorer below
+        EPS = 1e-4
+        pending: deque = deque()  # stage-A gate dispatches in flight
+        cand_rows: dict[int, list[np.ndarray]] = {}  # T -> candidate rows
+        cand_tis: dict[int, list[np.ndarray]] = {}
+
+        def fetch_gate() -> None:
+            dev, rows_p, tis = pending.popleft()
+            counts = np.asarray(dev)[: len(tis)]
+            sel = np.nonzero(counts > 0)[0]
+            if len(sel):
+                T = rows_p.shape[1]
+                cand_rows.setdefault(T, []).append(rows_p[sel])
+                cand_tis.setdefault(T, []).append(tis[sel])
+
+        dp = max(1, scorer.data_parallelism)
+
+        def bucket_rows(n: int) -> int:
+            b = max(8, dp)
+            while b < n:
+                b *= 2
+            return -(-b // dp) * dp  # non-power-of-two meshes
+
+        def pad_rows(part: np.ndarray, b: int) -> np.ndarray:
+            if b == len(part):
+                return part
+            pad = np.full(
+                (b - len(part), part.shape[1]), ng.PAD_KEY, np.int32
             )
-            chunk_len = 8192
-            backend = self.backend
-            if backend == "auto":
-                import jax
+            return np.concatenate([part, pad])
 
-                backend = (
-                    "pallas"
-                    if jax.devices()[0].platform not in ("cpu", "METAL")
-                    else "xla"
+        # stage A: cheap corpus-intersection gate over every row — ~99% of
+        # scanned files share no gram with any license text, so the
+        # expensive credit-gather kernel below only ever sees the rest
+        for rows, tis in groups:
+            for off in range(0, len(rows), MAX_DEVICE_ROWS):
+                part_t = tis[off : off + MAX_DEVICE_ROWS]
+                part = pad_rows(
+                    rows[off : off + MAX_DEVICE_ROWS],
+                    bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
                 )
-            if backend == "pallas":
-                from trivy_tpu.ops.match_pallas import build_match_fn_pallas
+                pending.append((scorer.gate(part), part, part_t))
+                if len(pending) >= DEVICE_PIPELINE_DEPTH:
+                    fetch_gate()
+        while pending:
+            fetch_gate()
 
-                fn = build_match_fn_pallas(compiled, chunk_len)
-            else:
-                fn = build_match_fn(compiled, chunk_len)
-            self._device = (fn, chunk_len, compiled.span + 1)
-        return self._device
+        # stage B: full credit scoring for the flagged rows only; scores
+        # accumulate compactly per gated text (never a dense
+        # [n_texts, n_licenses] matrix — the header analyzer batches every
+        # source file of a scan into one call)
+        spending: deque = deque()
+        acc: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+        def fetch_score() -> None:
+            dev, tis = spending.popleft()
+            fw_d, pp_d = dev
+            fw_np = np.asarray(fw_d, dtype=np.float64)
+            pp_np = np.asarray(pp_d, dtype=np.float64)
+            for i, ti in enumerate(tis.tolist()):
+                acc[ti] = (fw_np[i, :L], pp_np[i, :L])
+
+        for T in sorted(cand_rows):
+            rows = np.concatenate(cand_rows[T])
+            tis = np.concatenate(cand_tis[T])
+            for off in range(0, len(rows), MAX_DEVICE_ROWS):
+                part_t = tis[off : off + MAX_DEVICE_ROWS]
+                part = pad_rows(
+                    rows[off : off + MAX_DEVICE_ROWS],
+                    bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
+                )
+                spending.append((scorer(part), part_t))
+                if len(spending) >= DEVICE_PIPELINE_DEPTH:
+                    fetch_score()
+        while spending:
+            fetch_score()
+
+        # texts too large for one gram row take the host oracle directly
+        overflow_set = set(overflow)
+        for ti in overflow_set:
+            out[ti] = self.classify(texts[ti])
+
+        # candidate gate on device scores: a license is worth finalizing
+        # when its potential confidence (full lane, or phrase lane with
+        # every short phrase assumed present) clears the threshold —
+        # the int32 fold only ever overcounts vs the host oracle (see
+        # ops/ngram_score) and EPS covers the two-sided f32 summation
+        # rounding with orders of magnitude to spare, so this never
+        # drops a passing candidate
+        wtot = table.wtot
+        n_units = table.n_units
+        n_short = table.n_short
+        by_text: dict[int, set[int]] = {}
+        zero_row = np.zeros(L, dtype=np.float64)
+        for ti, (fw_row, pp_row) in acc.items():
+            with np.errstate(divide="ignore", invalid="ignore"):
+                cf = np.where(wtot > 0, fw_row / np.maximum(wtot, 1e-300), 0.0)
+                pot_p = np.where(
+                    n_units > 0, (pp_row + n_short) / np.maximum(n_units, 1), 0.0
+                )
+            pot = np.maximum(cf, pot_p)
+            pot[~((fw_row > 0) | (pp_row > 0))] = 0.0
+            lis = np.nonzero(pot >= self.confidence - EPS)[0]
+            if len(lis):
+                by_text[ti] = set(lis.tolist())
+
+        norm_cache: dict[int, str] = {}
+
+        def get_norm(ti: int) -> str:
+            if ti not in norm_cache:
+                norm_cache[ti] = normalize(texts[ti])
+            return norm_cache[ti]
+
+        # short-phrase anchor lane stays host-side (device rows carry gram
+        # keys only; single-word anchors gate here exactly as in the host
+        # batch path)
+        if self._short_gate and len(whashes):
+            wb = self._anchor_bloom[whashes & self._BLOOM_MASK]
+            surv_idx = np.nonzero(wb)[0]
+            if len(surv_idx):
+                sh = whashes[surv_idx]
+                ap = np.searchsorted(self._anchor_sorted, sh)
+                ap[ap >= len(self._anchor_sorted)] = 0
+                exact = self._anchor_sorted[ap] == sh
+                seen: set[tuple[int, int]] = set()
+                for wi, ai in zip(
+                    surv_idx[exact].tolist(), ap[exact].tolist()
+                ):
+                    ti = int(word_text[wi])
+                    if (ti, ai) in seen:
+                        continue
+                    seen.add((ti, ai))
+                    for gi in self._anchor_gates[
+                        self._anchor_off[ai] : self._anchor_off[ai + 1]
+                    ].tolist():
+                        li, ph, _anchor = self._short_gate[gi]
+                        if li not in by_text.get(ti, ()) and ph in get_norm(ti):
+                            by_text.setdefault(ti, set()).add(li)
+
+        for ti, cands in by_text.items():
+            if ti in overflow_set:
+                continue  # already resolved by the host oracle
+            norm = get_norm(ti)
+            fw_row, pp_row = acc.get(ti, (zero_row, zero_row))
+            grams = None  # host int64 grams, computed only if needed
+            scored: list[tuple[float, float, str]] = []
+            for li in cands:
+                lic = self.licenses[li]
+                shorts = self._phrase_short[lic]
+                got_short = (
+                    sum(1 for p in shorts if p in norm) if shorts else 0
+                )
+                nu = int(n_units[li])
+                conf_p = (pp_row[li] + got_short) / nu if nu else 0.0
+                cf = fw_row[li] / wtot[li] if wtot[li] > 0 else 0.0
+                conf = max(cf, conf_p)
+                if abs(conf - self.confidence) <= EPS:
+                    # float32 device sums can land a hair on either side
+                    # of the threshold: settle the call with the exact
+                    # host scorer (rare — only threshold-grazing texts)
+                    if grams is None:
+                        grams = self._text_grams(norm)
+                    conf, matched_w = self._score(li, norm, grams)
+                    if conf >= self.confidence:
+                        scored.append((conf, matched_w, lic))
+                elif conf >= self.confidence:
+                    scored.append((float(conf), float(fw_row[li]), lic))
+            out[ti] = self._rank_findings(scored)
+        return out
+
+    def _device_scorer(self):
+        """Process-cached device scorer with the corpus table resident in
+        device memory across calls, scans and classifier instances."""
+        if self._scorer is None:
+            from trivy_tpu.ops import ngram_score as ng
+
+            if not hasattr(self, "_gate_keys"):
+                self._build_scoring()
+
+            def build(model_shards: int):
+                return ng.build_corpus_table(
+                    self.licenses,
+                    self._full_keys,
+                    self._full_weights,
+                    self._phrase_keys,
+                    self._phrase_short,
+                    model_shards=model_shards,
+                )
+
+            self._scorer = ng.get_scorer(build, mesh=self.mesh)
+        return self._scorer
 
     # -- shared scoring -----------------------------------------------------
 
@@ -322,6 +534,24 @@ class LicenseClassifier:
     _P2 = np.int64(1099511628211)
     _HASH_P = np.int64(1099511628211)
     _ARANGE = np.arange(1 << 20, dtype=np.int64)  # reused position buffer
+
+    _ARANGE_CAP = 1 << 23  # 64 MB int64: largest buffer worth pinning
+
+    @classmethod
+    def _positions(cls, n: int) -> np.ndarray:
+        """Shared 0..n-1 int64 view, growing the cached buffer on demand
+        (batch joins run to several MB; a fresh arange per call costs more
+        than the hash itself). Growth is capped: a one-off giant batch
+        gets a throwaway arange instead of pinning GBs on the class."""
+        if n <= len(cls._ARANGE):
+            return cls._ARANGE[:n]
+        if n <= cls._ARANGE_CAP:
+            size = len(cls._ARANGE)
+            while size < n:
+                size *= 2
+            cls._ARANGE = np.arange(size, dtype=np.int64)
+            return cls._ARANGE[:n]
+        return np.arange(n, dtype=np.int64)
 
     @classmethod
     def _gram_words(cls, text: str) -> list[str]:
@@ -351,11 +581,7 @@ class LicenseClassifier:
         starts = np.nonzero(nz & ~prev_nz)[0]
         if len(starts) == 0:
             return np.zeros(0, dtype=np.int64)
-        pos = (
-            cls._ARANGE[:n]
-            if n <= len(cls._ARANGE)
-            else np.arange(n, dtype=np.int64)
-        )
+        pos = cls._positions(n)
         s0 = np.add.reduceat(bm, starts)
         # position-weighted sum, rebased per word: sum(b*i) - start*sum(b)
         s1 = np.add.reduceat(bm * pos, starts) - starts * s0
@@ -387,7 +613,58 @@ class LicenseClassifier:
             self._word_hashes(" ".join(words_or_text))
         )
 
+    # corpus-derived attributes shared across instances via the
+    # process-level _static_scoring_tables() cache
+    _STATIC_ATTRS = (
+        "_full_keys", "_full_weights", "_family", "_phrase_keys",
+        "_phrase_short", "_BLOOM_MASK", "_gate_keys", "_gate_off",
+        "_gate_lic", "_gate_bloom", "_short_gate", "_short_anchors",
+        "_anchor_sorted", "_anchor_off", "_anchor_gates", "_anchor_bloom",
+    )
+
+    @classmethod
+    def _compute_static_tables(cls) -> dict:
+        """Build the corpus-derived scoring tables once per process on a
+        bare probe instance; every classifier shares the result (the
+        analyzer constructs a classifier per finalize — rebuilding the
+        corpus tables per scan would dwarf the scan itself)."""
+        probe = cls.__new__(cls)
+        probe.licenses = sorted(NORMALIZED_FINGERPRINTS)
+        probe.phrases = []
+        for li, lic in enumerate(probe.licenses):
+            for ph in NORMALIZED_FINGERPRINTS[lic]:
+                probe.phrases.append((li, ph))
+        probe._compute_scoring_impl()
+        return {name: getattr(probe, name) for name in cls._STATIC_ATTRS}
+
     def _build_scoring(self) -> None:
+        for name, value in _static_scoring_tables().items():
+            setattr(self, name, value)
+        # batch-gate pruning floor per license: the minimum gate-hit count
+        # below which neither lane can reach the confidence threshold
+        # (full lane: conf <= count * w_max / w_total; phrase lane:
+        # conf <= (count + n_short) / n_units) — safe upper bounds, so
+        # pruning can never drop a passing candidate. Confidence-dependent,
+        # hence per instance rather than in the shared tables.
+        self._prune_min: list[float] = []
+        for li, lic in enumerate(self.licenses):
+            full_min = float("inf")
+            keys = self._full_keys.get(lic)
+            if keys is not None and len(keys):
+                w = self._full_weights[lic]
+                wmax = float(w.max())
+                if wmax > 0:
+                    full_min = self.confidence * float(w.sum()) / wmax
+            n_short = len(self._phrase_short[lic])
+            n_units = len(self._phrase_keys[lic]) + n_short
+            phrase_min = (
+                max(0.0, self.confidence * n_units - n_short)
+                if n_units
+                else float("inf")
+            )
+            self._prune_min.append(min(full_min, phrase_min) - 1e-9)
+
+    def _compute_scoring_impl(self) -> None:
         """Two scoring lanes, built once:
 
         - **full-text lane**: distinctiveness-weighted gram tables from the
@@ -520,29 +797,6 @@ class LicenseClassifier:
         if len(self._anchor_sorted):
             self._anchor_bloom[self._anchor_sorted & self._BLOOM_MASK] = True
 
-        # batch-gate pruning floor per license: the minimum gate-hit count
-        # below which neither lane can reach the confidence threshold
-        # (full lane: conf <= count * w_max / w_total; phrase lane:
-        # conf <= (count + n_short) / n_units) — safe upper bounds, so
-        # pruning can never drop a passing candidate
-        self._prune_min: list[float] = []
-        for li, lic in enumerate(self.licenses):
-            full_min = float("inf")
-            keys = self._full_keys.get(lic)
-            if keys is not None and len(keys):
-                w = self._full_weights[lic]
-                wmax = float(w.max())
-                if wmax > 0:
-                    full_min = self.confidence * float(w.sum()) / wmax
-            n_short = len(self._phrase_short[lic])
-            n_units = len(self._phrase_keys[lic]) + n_short
-            phrase_min = (
-                max(0.0, self.confidence * n_units - n_short)
-                if n_units
-                else float("inf")
-            )
-            self._prune_min.append(min(full_min, phrase_min) - 1e-9)
-
     def _text_grams(self, norm: str) -> np.ndarray:
         if not hasattr(self, "_gate_keys"):
             self._build_scoring()
@@ -583,11 +837,6 @@ class LicenseClassifier:
             phrase_conf = got / n_units
         return max(full_conf, phrase_conf), matched_w
 
-    def _findings(self, phrase_hits: np.ndarray, norm: str) -> list[LicenseFinding]:
-        # device-prefilter entry: exact-phrase hits gate candidates
-        candidates = {li for i, (li, _ph) in enumerate(self.phrases) if phrase_hits[i]}
-        return self._findings_candidates(candidates, norm, self._text_grams(norm))
-
     def _findings_candidates(
         self, candidates: set[int], norm: str, grams: np.ndarray
     ) -> list[LicenseFinding]:
@@ -598,6 +847,14 @@ class LicenseClassifier:
             conf, matched_w = self._score(li, norm, grams)
             if conf >= self.confidence:
                 found.append((conf, matched_w, self.licenses[li]))
+        return self._rank_findings(found)
+
+    def _rank_findings(
+        self, found: list[tuple[float, float, str]]
+    ) -> list[LicenseFinding]:
+        """Rank scored (confidence, matched_weight, license) candidates
+        into findings — shared by the host scorer and the device scoring
+        path, so ranking/suppression semantics cannot diverge."""
         if not found:
             return []
         # a fully-matched license suppresses phrase-level siblings it subsumes

@@ -605,4 +605,28 @@ def render_charts(files: dict[str, bytes]) -> dict[str, str]:
                 continue
             if rendered.strip():
                 out[p] = rendered
+        # chart yaml outside templates/ (crds/, chart-adjacent manifests)
+        # installs verbatim in helm — flow it through this lane so it is
+        # scanned exactly once (the misconf scanner excludes chart dirs
+        # from its standalone pass and relies on this for coverage);
+        # Chart.yaml/values.yaml are chart config, not manifests
+        prefix = root + "/" if root else ""
+        for p in files:
+            if not p.startswith(prefix) or p.startswith(tpl_prefix + "/"):
+                continue
+            if os.path.basename(p) in ("Chart.yaml", "values.yaml"):
+                continue
+            # .json included: k8s manifests ship as JSON too, and the
+            # misconf scanner treats them as chart-owned under a root
+            if not p.endswith((".yaml", ".yml", ".json")):
+                continue
+            try:
+                rendered = renderer.render(
+                    files[p].decode("utf-8", "replace")
+                )
+            except Exception as e:
+                logger.debug("helm render failed for %s: %s", p, e)
+                continue
+            if rendered.strip():
+                out.setdefault(p, rendered)
     return out

@@ -112,16 +112,29 @@ class MisconfScanner:
                 _p.dirname(p) for p in helm_files
                 if _p.basename(p) == "Chart.yaml"
             }
-            # chart templates render through helm; scanning the raw
-            # template text as standalone kubernetes too would double-count
+
+            # yaml-ish files under a detected chart root belong to the
+            # chart: templates render through helm, and chart-root files
+            # (values.yaml, Chart.yaml) plus chart-adjacent manifests feed
+            # the render — scanning those standalone as well would
+            # double-count the same configuration (the reference hands the
+            # whole chart directory to the helm scanner). Other types
+            # (Dockerfile, CloudFormation, ARM) never enter the helm lane
+            # and keep their standalone pass even inside a chart dir.
+            _HELM_LANE = (
+                detection.FILE_TYPE_YAML, detection.FILE_TYPE_JSON,
+                detection.FILE_TYPE_KUBERNETES,
+            )
+
+            def _chart_owned(path: str, ftype: str) -> bool:
+                return ftype in _HELM_LANE and any(
+                    path.startswith(r + "/") if r else True for r in roots
+                )
+
             per_file = [
                 (path, ftype, content)
                 for path, ftype, content in per_file
-                if not any(
-                    path.startswith((_p.join(r, "templates") + "/") if r
-                                    else "templates/")
-                    for r in roots
-                )
+                if not _chart_owned(path, ftype)
             ]
             out.extend(self._scan_helm(helm_files))
         for path, ftype, content in per_file:

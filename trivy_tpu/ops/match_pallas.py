@@ -273,7 +273,7 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
     # KEYWORD_BATCH per kernel gets keyword-only kernels
     kws = list(compiled.keywords)
     kw_slices: list[tuple] = []
-    if var_groups:
+    if var_groups and kws:  # all-anchored rulesets have no keywords to fold
         per = min(KEYWORD_BATCH, -(-len(kws) // len(var_groups)))
         kw_slices = [tuple(kws[i : i + per]) for i in range(0, len(kws), per)]
     kernels = [

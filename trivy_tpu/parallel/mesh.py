@@ -15,6 +15,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.5 top-level spelling
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 def get_mesh(n_devices: int | None = None, model: int = 1, devices=None) -> Mesh:
     """A ('data', 'model') mesh over the given (or available, or first n)
@@ -54,7 +59,7 @@ def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
     multiple of data_parallelism * rows_multiple (see :func:`pad_batch`).
     """
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             match_fn, mesh=mesh, in_specs=(P("data", None),), out_specs=P("data", None)
         )
     )
@@ -63,6 +68,65 @@ def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
         return fn(jnp.asarray(chunks))
 
     run.data_parallelism = int(mesh.shape["data"]) * rows_multiple
+    return run
+
+
+def corpus_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Corpus fingerprint tables: leading shard axis over 'model', payload
+    replicated across 'data'. Used to commit the license n-gram corpus
+    (ops/ngram_score) to device memory once, HBM-resident across scans."""
+    return NamedSharding(mesh, P(*(("model",) + (None,) * (ndim - 1))))
+
+
+def sharded_score_fn(score_fn, mesh: Mesh):
+    """Shard an n-gram corpus scoring kernel over the 2D mesh: text gram
+    rows over 'data', corpus-fingerprint shards over 'model' (PAPER.md §7
+    — the first user of the mesh 'model' axis). Each device scores its
+    local row block against its local license slab with zero
+    communication; out_specs reassemble the global [B, L] score pair.
+
+    ``score_fn`` is :func:`trivy_tpu.ops.ngram_score.build_score_fn`'s
+    (rows, keys, credit) -> (full_w, phrase_hits). Batch size must be a
+    multiple of the mesh data parallelism (see ``run.data_parallelism``).
+    """
+    fn = jax.jit(
+        _shard_map(
+            score_fn,
+            mesh=mesh,
+            in_specs=(
+                P("data", None),  # gram rows [B/d, T]
+                P("model", None),  # corpus keys [m, Ku] -> local [1, Ku]
+                P("model", None, None),  # credit [m, Ku, 2*Ls]
+            ),
+            out_specs=(P("data", "model"), P("data", "model")),
+        )
+    )
+
+    def run(rows, keys, credit):
+        return fn(jnp.asarray(rows), keys, credit)
+
+    run.data_parallelism = int(mesh.shape["data"])
+    return run
+
+
+def sharded_gate_fn(gate_fn, mesh: Mesh):
+    """Shard the n-gram candidate gate: rows over 'data', corpus keys
+    over 'model'; ``gate_fn`` must be built with ``psum_axis='model'``
+    (ops/ngram_score.build_gate_fn) so per-shard intersection counts
+    reduce to global counts over ICI."""
+    fn = jax.jit(
+        _shard_map(
+            gate_fn,
+            mesh=mesh,
+            in_specs=(P("data", None), P("model", None)),
+            out_specs=P("data"),
+        )
+    )
+
+    def run(rows, keys):
+        return fn(jnp.asarray(rows), keys)
+
+    run.data_parallelism = int(mesh.shape["data"])
     return run
 
 
@@ -75,7 +139,7 @@ def hit_counts_psum(match_fn, mesh: Mesh):
         return jax.lax.psum(local, axis_name="data")
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             step,
             mesh=mesh,
             in_specs=(P("data", None),),

@@ -48,7 +48,7 @@ _TOKEN_RE = _re.compile(
     (?P<ws>[ \t\r]+)
   | (?P<comment>\#[^\n]*)
   | (?P<nl>\n)
-  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<number>\d+(?:\.\d+)?)
   | (?P<string>"(?:[^"\\]|\\.)*")
   | (?P<rawstring>`[^`]*`)
   | (?P<op>:=|==|!=|<=|>=|\||[{}\[\]();,.:<>=+\-*/%&])
@@ -92,6 +92,25 @@ def _tokenize(src: str) -> list[Tok]:
         if kind == "rawstring":
             toks.append(Tok("string", json.dumps(text[1:-1]), line))
             continue
+        if (
+            kind == "number"
+            and toks
+            and toks[-1].kind == "op"
+            and toks[-1].text == "-"
+        ):
+            # unary vs binary minus by previous-token context: `-` is a
+            # sign only when what precedes it cannot end a value, so
+            # `n-1` / `count(x)-1` stay subtraction while `x := -5` and
+            # `[-1]` get negative literals
+            prev = toks[-2] if len(toks) >= 2 else None
+            ends_value = prev is not None and (
+                prev.kind in ("number", "string")
+                or (prev.kind == "ident" and prev.text not in _KEYWORDS)
+                or (prev.kind == "op" and prev.text in (")", "]", "}"))
+            )
+            if not ends_value:
+                toks[-1] = Tok("number", "-" + text, toks[-1].line)
+                continue
         toks.append(Tok(kind, text, line))
     toks.append(Tok("eof", "", line))
     return toks

@@ -24,6 +24,17 @@ from functools import cached_property
 
 from trivy_tpu.types import Severity
 
+try:  # 3.11+ spelling of the sre internals
+    import re._compiler as _sre_compile
+    import re._constants as _sre_c
+    import re._parser as _sre_parse
+except ImportError:  # 3.10 and earlier expose them top-level
+    import sre_compile as _sre_compile
+    import sre_constants as _sre_c
+    import sre_parse as _sre_parse
+# 3.10 getwidth() saturates at MAXREPEAT; 3.11+ renamed it MAXWIDTH
+_SRE_MAXWIDTH = getattr(_sre_parse, "MAXWIDTH", _sre_c.MAXREPEAT)
+
 # Matches must not start mid-word: a token preceded by [0-9a-zA-Z] is part of a
 # longer word and not a credential boundary (ref: builtin-rules.go:81 startWord).
 _WORD_PREFIX = r"(?:^|[^0-9a-zA-Z])"
@@ -108,10 +119,10 @@ class Rule:
         """Upper bound on a match's length in chars, or None if unbounded
         (used to size span-restricted confirmation windows)."""
         try:
-            import re._parser as sre_parse
+            sre_parse = _sre_parse
 
             _, hi = sre_parse.parse(self.regex).getwidth()
-            return None if hi >= sre_parse.MAXWIDTH else int(hi)
+            return None if hi >= _SRE_MAXWIDTH else int(hi)
         except Exception:
             return None
 
@@ -134,10 +145,10 @@ class Rule:
         fall back to a full-content scan.
         """
         try:
-            import re._compiler as sre_compile
-            import re._parser as sre_parse
+            sre_compile = _sre_compile
+            sre_parse = _sre_parse
 
-            MAXW = sre_parse.MAXWIDTH
+            MAXW = _SRE_MAXWIDTH
 
             def item_width(state, op, av) -> int:
                 probe = sre_parse.SubPattern(state, [(op, av)])
@@ -195,8 +206,7 @@ class Rule:
         if not self.lower_keywords:
             return False
         try:
-            import re._constants as sre_c
-            import re._parser as sre_parse
+            sre_c, sre_parse = _sre_c, _sre_parse
 
             def fold_char(chars: frozenset) -> str | None:
                 """Single lowercase char every member folds to, or None."""
@@ -282,7 +292,7 @@ class Rule:
         scanning cannot bound the context they examine — such rules must take
         the full-content scan path to stay parity-identical."""
         try:
-            import re._parser as sre_parse
+            sre_parse = _sre_parse
 
             def walk(items) -> bool:
                 for op, av in items:
@@ -313,8 +323,7 @@ class Rule:
         not — window-restricted scanning re-verifies such edge matches
         against the real string end (engine.find_rule_locations_in_windows)."""
         try:
-            import re._constants as sre_c
-            import re._parser as sre_parse
+            sre_c, sre_parse = _sre_c, _sre_parse
 
             def walk(items) -> bool:
                 for op, av in items:
