@@ -50,6 +50,36 @@ def test_hit_counts_psum():
     assert counts.sum() == 3
 
 
+def test_round_robin_dispatch_parity():
+    """Multi-stream round-robin dispatch (whole batches to each device in
+    turn, no collectives) must match the host oracle. Restricted ruleset +
+    4 devices: jit compiles one executable per device placement."""
+    from trivy_tpu.secret.engine import ScannerConfig
+
+    ids = ["github-pat", "slack-access-token", "jwt-token", "private-key"]
+    cfg = ScannerConfig.from_dict({"enable-builtin-rules": ids})
+    cpu = SecretScanner(cfg)
+    rr = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=8,
+        dispatch="round_robin", devices=jax.devices()[:4],
+    )
+    assert rr._match.n_streams == 4
+    files = [
+        (f"f{i}.txt", f"head\n{SAMPLES[r]}\ntail\n".encode() + b"pad line\n" * 400)
+        for i, r in enumerate(ids * 3)
+    ]
+    for (path, data), secret in zip(files, rr.scan_files(files)):
+        want = cpu.scan_bytes(path, data)
+        assert secret.to_dict() == want.to_dict()
+
+
+def test_round_robin_auto_stays_single_on_cpu():
+    """dispatch='auto' must not fan out over virtual CPU devices (they
+    share one memory bus; multi-stream there only adds copies)."""
+    t = TpuSecretScanner(chunk_len=1024, batch_size=8)
+    assert not hasattr(t._match, "n_streams")
+
+
 # -- license n-gram scoring on the 'model' axis ------------------------------
 
 

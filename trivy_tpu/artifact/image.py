@@ -21,7 +21,7 @@ import os
 import tarfile
 
 from trivy_tpu import log
-from trivy_tpu.artifact.local_fs import ArtifactOption
+from trivy_tpu.artifact.local_fs import DEFAULT_PARALLEL, ArtifactOption
 from trivy_tpu.cache.key import calc_key
 from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
 from trivy_tpu.fanal.handler import HandlerManager
@@ -273,7 +273,7 @@ class ImageArchiveArtifact:
                 # the base maintainer's problem; ref: image.go:209-213)
                 todo.append((i, diff_id, lkey, created_by, i in base_layers))
             # layer-parallel analysis (ref: image.go:205-231 parallel.Pipeline)
-            workers = min(len(todo), self.option.parallel or 4)
+            workers = min(len(todo), self.option.parallel or DEFAULT_PARALLEL)
             if workers > 1:
                 from concurrent.futures import ThreadPoolExecutor
 

@@ -22,6 +22,12 @@ from trivy_tpu.types import ArtifactReference
 
 logger = log.logger("artifact:fs")
 
+# default host worker count for read/analyze fan-out when --parallel is
+# unset — one constant for both artifact types (fs read-ahead pool, image
+# layer pool), matching the reference's --parallel default
+# (ref: pkg/flag/scan_flags.go:79-84)
+DEFAULT_PARALLEL = 5
+
 
 @dataclass
 class ArtifactOption:
@@ -75,7 +81,6 @@ class LocalFSArtifact:
 
     # reader-pool sizing: reads are GIL-releasing I/O; the window is bounded
     # by buffered bytes so huge files can't pile up in memory
-    READ_WORKERS = 8
     PREFETCH_BYTES = 256 << 20
     PREFETCH_FILES = 128
 
@@ -93,7 +98,7 @@ class LocalFSArtifact:
         # ahead of the (serial) analyzer loop — the TPU-era equivalent of the
         # reference's per-file goroutine fan-out (ref: analyzer.go:403-455),
         # restructured as read-ahead feeding batched device collection
-        workers = self.option.parallel or self.READ_WORKERS
+        workers = self.option.parallel or DEFAULT_PARALLEL
         with ThreadPoolExecutor(max_workers=workers) as pool:
             window: deque = deque()  # (rel, info, future)
             buffered = 0

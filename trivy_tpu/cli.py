@@ -117,6 +117,16 @@ def secret_flags() -> FlagGroup:
         [
             Flag("secret-config", default="trivy-secret.yaml",
                  config_name="secret.config", help="secret rules config file"),
+            Flag("no-secret-dedup", default=False, value_type=bool,
+                 config_name="secret.no-dedup",
+                 help="disable the chunk-dedup hit cache on the device feed"),
+            Flag("no-secret-pack", default=False, value_type=bool,
+                 config_name="secret.no-pack",
+                 help="disable small-file row packing on the device feed"),
+            Flag("secret-hit-cache", default=False, value_type=bool,
+                 config_name="secret.hit-cache",
+                 help="persist chunk hit vectors in the scan cache backend "
+                      "(fs/redis) for cross-scan dedup"),
         ],
     )
 

@@ -74,6 +74,13 @@ def _artifact_option(ns, opts):
             "misconfig_scanners": list(opts.get("misconfig_scanners") or []),
             "parallel": max(0, int(opts.get("parallel") or 0)),
             "java_db_path": opts.get("java_db"),
+            "secret_dedup": not opts.get("no_secret_dedup"),
+            "secret_pack": not opts.get("no_secret_pack"),
+            # own cache handle: the hit-vector store outlives any single
+            # artifact's cache usage and redis/fs backends are cheap to dup
+            "secret_hit_cache": (
+                _make_cache(opts) if opts.get("secret_hit_cache") else None
+            ),
         },
         parallel=max(0, int(opts.get("parallel") or 0)),
         insecure_registry=bool(opts.get("insecure")),

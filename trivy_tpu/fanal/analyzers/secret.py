@@ -36,8 +36,15 @@ _scanner_lock = __import__("threading").Lock()
 _scanner_cache: dict = {}
 
 
-def _shared_scanner(config, backend: str, parallel: int):
-    key = (id(config) if config is not None else None, backend, parallel)
+def _shared_scanner(
+    config, backend: str, parallel: int,
+    dedup: bool = True, pack_small: bool = True, hit_cache=None,
+):
+    key = (
+        id(config) if config is not None else None,
+        backend, parallel, dedup, pack_small,
+        id(hit_cache) if hit_cache is not None else None,
+    )
     with _scanner_lock:
         if key not in _scanner_cache:
             if backend == "cpu":
@@ -46,7 +53,8 @@ def _shared_scanner(config, backend: str, parallel: int):
                 from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
 
                 _scanner_cache[key] = TpuSecretScanner(
-                    config, confirm_workers=parallel
+                    config, confirm_workers=parallel,
+                    dedup=dedup, pack_small=pack_small, hit_cache=hit_cache,
                 )
         return _scanner_cache[key]
 
@@ -97,7 +105,13 @@ class SecretAnalyzer(BatchAnalyzer):
         backend = getattr(options, "backend", "auto")
         self._config = cfg
         self._backend = backend
-        self._parallel = int((getattr(options, "extra", {}) or {}).get("parallel", 0))
+        extra = getattr(options, "extra", {}) or {}
+        self._parallel = int(extra.get("parallel", 0))
+        # feed-path knobs (--no-secret-dedup / --no-secret-pack /
+        # --secret-hit-cache), defaulting to dedup+packing on
+        self._dedup = bool(extra.get("secret_dedup", True))
+        self._pack = bool(extra.get("secret_pack", True))
+        self._hit_cache = extra.get("secret_hit_cache")
         self._scanner = None  # built lazily so CPU-only runs never touch jax
         self._files: list[tuple[str, bytes]] = []
         self._buffered = 0
@@ -125,7 +139,9 @@ class SecretAnalyzer(BatchAnalyzer):
     def _exact(self) -> SecretScanner:
         if self._scanner is None:
             self._scanner = _shared_scanner(
-                self._config, self._backend, self._parallel
+                self._config, self._backend, self._parallel,
+                dedup=self._dedup, pack_small=self._pack,
+                hit_cache=self._hit_cache,
             )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 

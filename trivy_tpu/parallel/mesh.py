@@ -10,6 +10,8 @@ shards, advisory-DB shards) that shard their lookup tables.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -68,6 +70,39 @@ def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
         return fn(jnp.asarray(chunks))
 
     run.data_parallelism = int(mesh.shape["data"]) * rows_multiple
+    return run
+
+
+def round_robin_match_fn(match_fn, devices=None, rows_multiple: int = 1):
+    """Multi-stream dispatch: whole batches round-robin across local devices.
+
+    The mesh-sharded collective splits ONE batch across devices — every
+    batch still rides a single host→device transfer stream. This wrapper
+    instead sends each whole batch to the next device in turn, so the
+    transfer for batch N+1 (device k) overlaps the kernel for batch N
+    (device j): on multi-chip hosts the effective host→device link
+    bandwidth multiplies by the device count. No collectives are involved;
+    each dispatch is an independent per-device program (jit compiles one
+    executable per placement), and callers fetch results in dispatch order
+    exactly as with the single-device path.
+    """
+    devices = list(devices) if devices is not None else jax.local_devices()
+    if not devices:
+        raise ValueError("round_robin_match_fn needs at least one device")
+    fn = jax.jit(match_fn)
+    lock = threading.Lock()
+    state = {"next": 0}
+
+    def run(chunks: np.ndarray) -> jax.Array:
+        with lock:
+            i = state["next"]
+            state["next"] = (i + 1) % len(devices)
+        if rows_multiple > 1:
+            chunks = pad_batch(chunks, rows_multiple)
+        return fn(jax.device_put(chunks, devices[i]))
+
+    run.n_streams = len(devices)
+    run.devices = devices
     return run
 
 

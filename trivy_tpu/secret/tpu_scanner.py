@@ -23,13 +23,35 @@ Dispatch shapes are drawn from a fixed bucket ladder (B, B/2, B/4, ...) so
 every shape compiles exactly once; exact host confirmation runs in a small
 thread pool that overlaps with the blocking device-result fetches (which
 release the GIL).
+
+The feed path sends link bytes ≪ corpus bytes (the host→device link, not
+the kernel, is the e2e ceiling):
+
+- **chunk-dedup hit cache**: every row is content-hashed (keyed blake2b,
+  key = ruleset fingerprint so a rule add/remove/change flips every key)
+  and duplicate rows — vendored deps, repeated OCI layer content, zero
+  pages — reuse the cached per-rule hit vector with no upload and no
+  kernel. Sound because the hit vector is a pure function of (row bytes,
+  compiled tables); path-dependent filtering happens later, host-side.
+  Bounded in-process LRU, optionally persisted through the trivy_tpu.cache
+  layer (fs/redis) for cross-scan reuse — the same insight as the
+  reference's layer cache: never re-scan content already seen.
+- **small-file row packing**: files smaller than a row share one row,
+  separated by ≥-span zero guard gaps. A real match's device program reads
+  only match bytes (+1 boundary byte), so packing can never suppress a hit;
+  cross-file windows only add false candidates that the exact host confirm
+  discards.
+- **round-robin multi-stream dispatch** (parallel.mesh.round_robin_match_fn)
+  sends whole batches to each local device in turn so transfers overlap
+  kernels across devices, multiplying effective link bandwidth.
 """
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from collections.abc import Iterable, Iterator
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -58,6 +80,12 @@ PALLAS_BATCH = 1024
 PIPELINE_DEPTH = 3
 # workers for exact host confirmation (overlaps device-result waits)
 CONFIRM_WORKERS = 4
+# bounded in-process LRU for the chunk-dedup hit cache; most entries are an
+# empty tuple (clean chunk), so 64k entries cost a few MB
+HIT_CACHE_ENTRIES = 1 << 16
+# bump when device-compile semantics change in a way that alters hit
+# vectors for identical (rules, chunk) inputs — invalidates persisted caches
+HIT_CACHE_VERSION = 1
 
 
 def chunk_spans(n: int, chunk_len: int, overlap: int) -> list[int]:
@@ -78,6 +106,40 @@ class _FileState:
     rules: dict[int, list[tuple[int, int]]] = field(default_factory=dict)
 
 
+class ScanStats:
+    """Cumulative link-traffic counters (thread-safe): bench snapshots
+    before/after a scan to compute link_bytes_per_corpus_byte and the
+    dedup hit rate. ``bytes_uploaded`` counts padded row bytes actually
+    dispatched (real link traffic incl. bucket padding); ``bytes_dedup_hit``
+    counts corpus bytes whose rows were served from the hit cache or
+    coalesced onto an identical in-flight row."""
+
+    FIELDS = (
+        "bytes_in",          # corpus bytes fed to the device path
+        "bytes_uploaded",    # padded row bytes dispatched over the link
+        "bytes_dedup_hit",   # corpus bytes resolved without an upload
+        "bytes_packed",      # corpus bytes sharing a row with another file
+        "chunks",            # rows the corpus decomposed into
+        "chunks_uploaded",   # rows actually dispatched
+        "chunks_dedup_hit",  # rows served from the hit cache / coalesced
+        "rows_packed",       # dispatched rows carrying >1 file segment
+        "files_packed",      # files that rode a shared row
+    )
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._v = dict.fromkeys(self.FIELDS, 0)
+
+    def add(self, **kw: int) -> None:
+        with self._lock:
+            for k, n in kw.items():
+                self._v[k] += n
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._v)
+
+
 class TpuSecretScanner:
     """Drop-in equivalent of :class:`SecretScanner` batched over TPU.
 
@@ -94,6 +156,12 @@ class TpuSecretScanner:
         mesh=None,
         backend: str = "auto",
         confirm_workers: int = 0,  # 0 = CONFIRM_WORKERS default
+        dedup: bool = True,
+        pack_small: bool = True,
+        hit_cache_entries: int = HIT_CACHE_ENTRIES,
+        hit_cache=None,  # trivy_tpu.cache backend for cross-scan persistence
+        dispatch: str = "auto",  # 'auto' | 'single' | 'round_robin'
+        devices=None,  # explicit device list for round-robin dispatch
     ):
         import jax
 
@@ -140,13 +208,60 @@ class TpuSecretScanner:
         }
         self.confirm_workers = confirm_workers or CONFIRM_WORKERS
 
-        from trivy_tpu.parallel.mesh import pad_batch, sharded_match_fn
+        # -- dedup hit cache ------------------------------------------------
+        # ruleset fingerprint: the hit vector is a pure function of
+        # (row bytes, compiled tables); keying the row hash with this
+        # fingerprint makes any rule add/remove/regex/keyword change — and
+        # any reordering, which renumbers rule indices — flip every key
+        fp = hashlib.blake2b(digest_size=16)
+        fp.update(f"v{HIT_CACHE_VERSION}:{self.chunk_len}:".encode())
+        for r in self.exact.rules:
+            fp.update(repr((r.id, r.regex, r.keywords, r.path)).encode())
+            fp.update(b"\x00")
+        self.ruleset_fingerprint = fp.digest()
+        self._dedup = dedup
+        self._pack_small = pack_small
+        self._hit_lru: OrderedDict[bytes, tuple[int, ...]] = OrderedDict()
+        self._hit_lru_max = hit_cache_entries
+        self._hit_lock = threading.Lock()
+        self._hit_persist = hit_cache
+        self.stats = ScanStats()
+
+        from trivy_tpu.parallel.mesh import (
+            pad_batch,
+            round_robin_match_fn,
+            sharded_match_fn,
+        )
+
+        if dispatch not in ("auto", "single", "round_robin"):
+            raise ValueError(
+                f"dispatch={dispatch!r}: use 'auto', 'single', or 'round_robin'"
+            )
+        self._pipeline_depth = PIPELINE_DEPTH
+        rr_devices = None
+        if mesh is None and dispatch != "single":
+            devs = list(devices) if devices is not None else jax.local_devices()
+            # 'auto' opts in only on real multi-accelerator hosts; the CPU
+            # backend's virtual devices share one memory bus, so multi-stream
+            # dispatch there only adds copies (tests opt in explicitly)
+            if len(devs) > 1 and (
+                dispatch == "round_robin" or devs[0].platform not in ("cpu",)
+            ):
+                rr_devices = devs
 
         if mesh is not None:
             inner = sharded_match_fn(match_fn, mesh, rows_multiple=rows_mult)
             dp = inner.data_parallelism
             self._match = lambda b: inner(pad_batch(b, dp))
             row_multiple = dp
+        elif rr_devices is not None:
+            self._match = round_robin_match_fn(
+                match_fn, rr_devices, rows_multiple=rows_mult
+            )
+            row_multiple = rows_mult
+            # keep every transfer stream busy: at least one batch in flight
+            # per device plus the usual dispatch-ahead margin
+            self._pipeline_depth = PIPELINE_DEPTH + len(rr_devices) - 1
         elif rows_mult > 1:
             self._match = lambda b: match_fn(pad_batch(b, rows_mult))
             row_multiple = rows_mult
@@ -165,6 +280,48 @@ class TpuSecretScanner:
         ):
             buckets.append(buckets[-1] // 2)
         self._buckets = sorted(buckets)
+
+    # -- dedup hit cache ----------------------------------------------------
+
+    def _persist_key(self, key: bytes) -> str:
+        return f"secret-hitv:{self.ruleset_fingerprint.hex()}:{key.hex()}"
+
+    def _hit_get(self, key: bytes) -> tuple[int, ...] | None:
+        """Cached per-rule hit vector for a row digest, or None."""
+        with self._hit_lock:
+            v = self._hit_lru.get(key)
+            if v is not None:
+                self._hit_lru.move_to_end(key)
+                return v
+        if self._hit_persist is not None:
+            blob = self._hit_persist.get_blob(self._persist_key(key))
+            if blob is not None:
+                v = tuple(blob["r"])
+                self._lru_insert(key, v)
+                return v
+        return None
+
+    def clear_hit_cache(self) -> None:
+        """Drop the in-process hit LRU (persisted entries are untouched) —
+        used by bench to measure the cold feed path."""
+        with self._hit_lock:
+            self._hit_lru.clear()
+
+    def _lru_insert(self, key: bytes, hit_rules: tuple[int, ...]) -> None:
+        """Insert under the entry bound — every LRU write path must evict,
+        or persisted-cache re-scans of large corpora grow RSS unboundedly."""
+        with self._hit_lock:
+            self._hit_lru[key] = hit_rules
+            self._hit_lru.move_to_end(key)
+            while len(self._hit_lru) > self._hit_lru_max:
+                self._hit_lru.popitem(last=False)
+
+    def _hit_put(self, key: bytes, hit_rules: tuple[int, ...]) -> None:
+        self._lru_insert(key, hit_rules)
+        if self._hit_persist is not None:
+            self._hit_persist.put_blob(
+                self._persist_key(key), {"r": list(hit_rules)}
+            )
 
     # -- core batching loop -------------------------------------------------
 
@@ -196,7 +353,7 @@ class TpuSecretScanner:
                 batch, meta = item
                 with trace.span("secret.dispatch"):
                     pending.append((self._match(batch), meta))
-                if len(pending) >= PIPELINE_DEPTH:
+                if len(pending) >= self._pipeline_depth:
                     fetch_oldest()
             while pending:
                 fetch_oldest()
@@ -221,24 +378,33 @@ class TpuSecretScanner:
         states: dict[int, _FileState] = {}
         next_emit = 0
         total = 0
+        stats = self.stats
+        chunk_len = self.chunk_len
+        dedup = self._dedup
+        fp_key = self.ruleset_fingerprint
+        # row digest -> waiting segment lists: identical rows already
+        # dispatched but not yet resolved are coalesced here instead of
+        # being uploaded again (zero pages recur within a single batch)
+        inflight: dict[bytes, list[list[tuple[int, int, int]]]] = {}
 
         # ring of host batch buffers sized for every stage a batch can be
-        # in at once: queued to the device thread (PIPELINE_DEPTH), being
-        # dispatched (1), dispatched-but-unfetched (PIPELINE_DEPTH, matters
+        # in at once: queued to the device thread (pipeline depth), being
+        # dispatched (1), dispatched-but-unfetched (pipeline depth, matters
         # on the CPU backend where jax may alias the numpy buffer
         # zero-copy), plus the one being packed — refilling a ring slot
         # can then never touch a batch still in any of those stages
         bufs = [
-            np.zeros((self.batch_size, self.chunk_len), dtype=np.uint8)
-            for _ in range(2 * PIPELINE_DEPTH + 2)
+            np.zeros((self.batch_size, chunk_len), dtype=np.uint8)
+            for _ in range(2 * self._pipeline_depth + 2)
         ]
         buf_i = 0
         buf = bufs[0]
-        meta: list[int] = []  # file index per buffered chunk
+        # per-row feed metadata: (digest | None, [(fidx, win_start, win_end)])
+        meta: list[tuple[bytes | None, list[tuple[int, int, int]]]] = []
         pool = ThreadPoolExecutor(max_workers=self.confirm_workers)
         # the single device thread (see _device_loop); in_q's bound is the
         # feeder backpressure, out_q carries fetched hit matrices back
-        in_q: queue.Queue = queue.Queue(maxsize=PIPELINE_DEPTH)
+        in_q: queue.Queue = queue.Queue(maxsize=self._pipeline_depth)
         out_q: queue.Queue = queue.Queue()
         device_thread = threading.Thread(
             target=self._device_loop, args=(in_q, out_q), daemon=True
@@ -255,21 +421,38 @@ class TpuSecretScanner:
             finally:
                 confirm_slots.release()
 
-        def resolve(batch_hits: np.ndarray, batch_meta: list) -> None:
-            # one vectorized nonzero per batch, not one per row
-            rows, ridx = np.nonzero(batch_hits[: len(batch_meta)])
-            for row, r in zip(rows.tolist(), ridx.tolist()):
-                fidx, start = batch_meta[row]
-                states[fidx].rules.setdefault(r, []).append(
-                    (start, start + self.chunk_len)
-                )
-            for fidx, _ in batch_meta:
+        def apply_hits(
+            segs: list[tuple[int, int, int]], hit_rules: tuple[int, ...]
+        ) -> None:
+            """Credit one resolved row to its file segments: record candidate
+            windows (every row hit applies to every segment — cross-segment
+            false candidates are discarded by the exact confirm), then
+            retire each segment's pending count."""
+            for fidx, ws, we in segs:
+                st = states[fidx]
+                for r in hit_rules:
+                    st.rules.setdefault(r, []).append((ws, we))
+            for fidx, _, _ in segs:
                 st = states[fidx]
                 st.pending -= 1
                 if st.pending == 0:
                     confirm_slots.acquire()
                     results[fidx] = pool.submit(confirm_task, st)
                     del states[fidx]
+
+        def resolve(batch_hits: np.ndarray, batch_meta: list) -> None:
+            # one vectorized nonzero per batch, not one per row
+            rows, ridx = np.nonzero(batch_hits[: len(batch_meta)])
+            by_row: dict[int, list[int]] = {}
+            for row, r in zip(rows.tolist(), ridx.tolist()):
+                by_row.setdefault(row, []).append(r)
+            for row, (key, segs) in enumerate(batch_meta):
+                hit_rules = tuple(by_row.get(row, ()))
+                apply_hits(segs, hit_rules)
+                if key is not None:
+                    self._hit_put(key, hit_rules)
+                    for waiting in inflight.pop(key, ()):
+                        apply_hits(waiting, hit_rules)
 
         def drain_results(block: bool = False) -> bool:
             """Resolve fetched batches; returns False once the device
@@ -291,6 +474,8 @@ class TpuSecretScanner:
             if not meta:
                 return
             n = next(b for b in self._buckets if b >= len(meta))
+            stats.add(bytes_uploaded=n * chunk_len)
+            trace.count("secret.bytes_uploaded", n * chunk_len)
             in_q.put((buf[:n], meta))
             meta = []
             # rotate to the next ring buffer; full rows are overwritten on
@@ -300,6 +485,105 @@ class TpuSecretScanner:
             buf_i = (buf_i + 1) % len(bufs)
             buf = bufs[buf_i]
             drain_results()
+            # bound pack-row staleness to one batch: a lone small file must
+            # not sit in pack_pending while big files stream past it — its
+            # unresolved state would stall in-order emission and let results
+            # accumulate unbounded on a streaming scan. The partial pack row
+            # rides the next batch instead (re-entry is shallow: the fresh
+            # meta holds one row, far below batch_size, so no second flush)
+            if pack_pending:
+                emit_pack()
+
+        def feed_row(
+            key: bytes | None,
+            segs: list[tuple[int, int, int]],
+            parts: list[tuple[int, np.ndarray]],
+            nbytes: int,
+            packed: bool,
+        ) -> None:
+            """Resolve a row from the hit cache, coalesce onto an identical
+            in-flight row, or pack it into the current batch buffer."""
+            stats.add(chunks=1)
+            if key is not None:
+                cached = self._hit_get(key)
+                if cached is not None:
+                    stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
+                    trace.count("secret.bytes_dedup_hit", nbytes)
+                    apply_hits(segs, cached)
+                    return
+                waiting = inflight.get(key)
+                if waiting is not None:
+                    waiting.append(segs)
+                    stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
+                    trace.count("secret.bytes_dedup_hit", nbytes)
+                    return
+                inflight[key] = []
+            row = buf[len(meta)]
+            if packed:
+                row[:] = 0  # zero guard gaps + tail (ring rows hold stale data)
+                for off, piece in parts:
+                    row[off : off + len(piece)] = piece
+                if len(segs) > 1:
+                    stats.add(
+                        rows_packed=1, files_packed=len(segs), bytes_packed=nbytes
+                    )
+                    trace.count("secret.bytes_packed", nbytes)
+            else:
+                piece = parts[0][1]
+                row[: len(piece)] = piece
+                if len(piece) < chunk_len:
+                    row[len(piece):] = 0  # clear stale tail
+            stats.add(chunks_uploaded=1)
+            meta.append((key, segs))
+            if len(meta) == self.batch_size:
+                flush()
+
+        # small-file packing: files below a row's size accumulate here and
+        # share one row, separated by >=span zero gaps (see module docstring
+        # for why packing cannot suppress a real match)
+        gap = self.overlap
+        pack_max = chunk_len - gap
+        pack_pending: list[tuple[int, bytes]] = []
+        pack_len = 0
+
+        def emit_pack() -> None:
+            nonlocal pack_len
+            if not pack_pending:
+                return
+            items = list(pack_pending)
+            pack_pending.clear()
+            pack_len = 0
+            key = None
+            if dedup:
+                if len(items) == 1:
+                    # single-segment row == plain chunk-row layout: share the
+                    # plain digest domain so it dedups across both paths
+                    key = hashlib.blake2b(
+                        items[0][1], digest_size=16, key=fp_key
+                    ).digest()
+                else:
+                    h = hashlib.blake2b(
+                        digest_size=16, key=fp_key, person=b"packed-row"
+                    )
+                    for _, d in items:
+                        h.update(len(d).to_bytes(4, "little"))
+                        h.update(d)
+                    key = h.digest()
+            segs = []
+            parts = []
+            off = 0
+            for fidx, d in items:
+                segs.append((fidx, 0, len(d)))
+                parts.append((off, np.frombuffer(d, dtype=np.uint8)))
+                off += len(d) + gap
+            feed_row(key, segs, parts, sum(len(d) for _, d in items), True)
+
+        def add_small(fidx: int, data: bytes) -> None:
+            nonlocal pack_len
+            if pack_len and pack_len + gap + len(data) > chunk_len:
+                emit_pack()
+            pack_pending.append((fidx, data))
+            pack_len += (gap if pack_len else 0) + len(data)
 
         def drain() -> None:
             in_q.put(None)
@@ -314,25 +598,46 @@ class TpuSecretScanner:
                 # scanner.go:388-392) — no device work either
                 if self.exact.allow_path(path):
                     results[fidx] = Secret(file_path=path)
+                elif not data:
+                    # empty file: nothing for the device to match — resolve
+                    # host-side immediately (host-lane rules still run there)
+                    st = _FileState(path=path, data=data, pending=0)
+                    confirm_slots.acquire()
+                    results[fidx] = pool.submit(confirm_task, st)
                 else:
-                    starts = chunk_spans(len(data), self.chunk_len, self.overlap)
-                    states[fidx] = _FileState(path=path, data=data, pending=len(starts))
-                    arr = np.frombuffer(data, dtype=np.uint8)
-                    for s in starts:
-                        piece = arr[s : s + self.chunk_len]
-                        row = len(meta)
-                        buf[row, : len(piece)] = piece
-                        if len(piece) < self.chunk_len:
-                            buf[row, len(piece):] = 0  # clear stale tail
-                        meta.append((fidx, s))
-                        if len(meta) == self.batch_size:
-                            flush()
+                    stats.add(bytes_in=len(data))
+                    if self._pack_small and len(data) <= pack_max:
+                        states[fidx] = _FileState(path=path, data=data, pending=1)
+                        add_small(fidx, data)
+                    else:
+                        starts = chunk_spans(len(data), chunk_len, self.overlap)
+                        states[fidx] = _FileState(
+                            path=path, data=data, pending=len(starts)
+                        )
+                        arr = np.frombuffer(data, dtype=np.uint8)
+                        for s in starts:
+                            piece = arr[s : s + chunk_len]
+                            key = (
+                                hashlib.blake2b(
+                                    piece, digest_size=16, key=fp_key
+                                ).digest()
+                                if dedup
+                                else None
+                            )
+                            feed_row(
+                                key,
+                                [(fidx, s, s + chunk_len)],
+                                [(0, piece)],
+                                len(piece),
+                                False,
+                            )
                 # emit in order as soon as the contiguous prefix is done;
                 # block on a confirmation only when it is next in line
                 while next_emit in results:
                     r = results.pop(next_emit)
                     yield r.result() if isinstance(r, Future) else r
                     next_emit += 1
+            emit_pack()  # flush the partial pack row
             flush()  # dispatch the final partial batch
             drain()  # resolve whatever is still in flight
             while next_emit < total:

@@ -21,6 +21,7 @@ from contextlib import contextmanager
 _enabled = False
 _lock = threading.Lock()
 _spans: dict[str, list[float]] = defaultdict(list)
+_counters: dict[str, int] = defaultdict(int)
 
 
 def enable() -> None:
@@ -35,6 +36,7 @@ def enabled() -> bool:
 def reset() -> None:
     with _lock:
         _spans.clear()
+        _counters.clear()
 
 
 @contextmanager
@@ -58,8 +60,18 @@ def add(name: str, seconds: float) -> None:
             _spans[name].append(seconds)
 
 
+def count(name: str, n: int = 1) -> None:
+    """Accumulate an integer counter (byte/item tallies, e.g. the secret
+    feed path's bytes_packed / bytes_uploaded / bytes_dedup_hit); no-op
+    when tracing is off."""
+    if _enabled:
+        with _lock:
+            _counters[name] += n
+
+
 def report(out=None) -> None:
-    """Aggregate span table (count / total / mean), widest totals first."""
+    """Aggregate span table (count / total / mean), widest totals first,
+    followed by the integer counters."""
     if not _enabled:
         return
     out = out or sys.stderr
@@ -68,13 +80,19 @@ def report(out=None) -> None:
             (name, len(times), sum(times))
             for name, times in _spans.items()
         ]
-    if not rows:
+        counters = sorted(_counters.items())
+    if not rows and not counters:
         return
     rows.sort(key=lambda r: -r[2])
     out.write("\n-- trace " + "-" * 51 + "\n")
-    out.write(f"{'span':<38}{'count':>7}{'total':>10}{'mean':>10}\n")
-    for name, count, total in rows:
-        out.write(
-            f"{name:<38}{count:>7}{total:>9.3f}s{total / count:>9.4f}s\n"
-        )
+    if rows:
+        out.write(f"{'span':<38}{'count':>7}{'total':>10}{'mean':>10}\n")
+        for name, cnt, total in rows:
+            out.write(
+                f"{name:<38}{cnt:>7}{total:>9.3f}s{total / cnt:>9.4f}s\n"
+            )
+    if counters:
+        out.write(f"{'counter':<45}{'value':>15}\n")
+        for name, value in counters:
+            out.write(f"{name:<45}{value:>15}\n")
     out.write("-" * 60 + "\n")
