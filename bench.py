@@ -202,6 +202,9 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=3):
     stays a COLD feed-path number comparable across rounds; the warm/dedup
     win is measured separately by :func:`bench_dedup`.
     """
+    from trivy_tpu import obs
+    from trivy_tpu.obs import export as obs_export
+
     warm_buckets(scanner)
     total_bytes = sum(len(d) for _, d in files)
     reps_out = []
@@ -209,15 +212,20 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=3):
     for _ in range(reps):
         scanner.clear_hit_cache()
         s0 = scanner.stats.snapshot()
-        t0 = time.perf_counter()
-        n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
-        dt = time.perf_counter() - t0
+        # per-rep trace context: spans cost a few µs per batch/file against
+        # an MB-scale rep, and buy the per-rep stall-attribution verdict
+        # embedded in the BENCH json
+        with obs.scan_context(name="bench-e2e", enabled=True) as ctx:
+            t0 = time.perf_counter()
+            n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
+            dt = time.perf_counter() - t0
         s1 = scanner.stats.snapshot()
         link_after = bench_link(scanner, rng)
         mbs = total_bytes / dt / (1024 * 1024)
         rep_link = (link + link_after) / 2
         uploaded = s1["bytes_uploaded"] - s0["bytes_uploaded"]
         chunks = max(1, s1["chunks"] - s0["chunks"])
+        m = obs_export.metrics_dict(ctx)
         reps_out.append(
             {
                 "e2e_mbs": round(mbs, 2),
@@ -228,6 +236,11 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=3):
                 "dedup_hit_rate": round(
                     (s1["chunks_dedup_hit"] - s0["chunks_dedup_hit"]) / chunks, 3
                 ),
+                "stall": m["stall"],
+                "stage_p95_ms": {
+                    name: round(s["p95"] * 1e3, 3)
+                    for name, s in m["spans"].items()
+                },
             }
         )
         link = link_after
@@ -565,6 +578,67 @@ def bench_streaming(scanner, rng, total_mb=None) -> dict:
     }
 
 
+# stages every smoke rep must record: a refactor that silently drops
+# instrumentation from the secret feed path (the spans the stall verdict
+# and the perf rounds depend on) fails the smoke loudly instead of
+# shipping blind
+SMOKE_STAGES = (
+    "secret.feed_wait",
+    "secret.dispatch",
+    "secret.device_wait",
+    "secret.confirm",
+)
+
+
+def smoke(trace_out=None, metrics_out=None) -> int:
+    """One tiny traced rep: scan a small corpus with span recording on,
+    write the Chrome-trace/metrics exports, and fail loudly if any declared
+    pipeline stage recorded zero spans (catches silently-dropped
+    instrumentation). Tier-1-adjacent: tests/test_bench_smoke.py runs this
+    under the ``slow`` marker."""
+    from trivy_tpu import obs
+    from trivy_tpu.obs import export as obs_export, stall
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    rng = np.random.default_rng(7)
+    scanner = TpuSecretScanner()
+    files = make_corpus(4, rng)
+    # a few sub-row files so the packed-row path is exercised too
+    files += [
+        (f"smoke/small_{i}.txt", bytes(rng.integers(32, 127, 512, np.uint8)))
+        for i in range(8)
+    ]
+    warm_buckets(scanner)
+    with obs.scan_context(name="bench-smoke", enabled=True) as ctx:
+        n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
+    if trace_out:
+        obs_export.write_chrome_trace(ctx, trace_out)
+    if metrics_out:
+        obs_export.write_metrics_json(ctx, metrics_out)
+    recorded = {name for name, durs in ctx.snapshot().items() if durs}
+    missing = [s for s in SMOKE_STAGES if s not in recorded]
+    if missing:
+        print(
+            f"FATAL: declared pipeline stage(s) recorded zero spans: "
+            f"{missing} (recorded: {sorted(recorded)})",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        json.dumps(
+            {
+                "metric": "bench_smoke",
+                "findings": n_findings,
+                "stages": sorted(recorded),
+                "stall": stall.attribution(ctx),
+                "trace_out": trace_out,
+                "metrics_out": metrics_out,
+            }
+        )
+    )
+    return 0
+
+
 def main():
     from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
 
@@ -651,5 +725,17 @@ def main():
 if __name__ == "__main__":
     if _STREAMING_CHILD_FLAG in sys.argv:
         _streaming_child_main()
+    elif "--smoke" in sys.argv:
+
+        def _opt(flag):
+            if flag not in sys.argv:
+                return None
+            i = sys.argv.index(flag) + 1
+            if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+                print(f"error: {flag} requires a file path", file=sys.stderr)
+                sys.exit(2)
+            return sys.argv[i]
+
+        sys.exit(smoke(_opt("--trace-out"), _opt("--metrics-out")))
     else:
         main()

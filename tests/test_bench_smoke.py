@@ -1,0 +1,69 @@
+"""bench.py --smoke: one tiny traced rep that fails loudly if any declared
+pipeline stage recorded zero spans — the guard against silently-dropped
+instrumentation. Tier-1-adjacent (marked slow; the tier-1 run excludes it
+to stay within budget, CI perf rounds run it alongside the full bench)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_smoke_records_all_declared_stages(tmp_path):
+    trace_out = tmp_path / "smoke_trace.json"
+    metrics_out = tmp_path / "smoke_metrics.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [
+            sys.executable, "bench.py", "--smoke",
+            "--trace-out", str(trace_out),
+            "--metrics-out", str(metrics_out),
+        ],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=600,
+    )
+    assert p.returncode == 0, f"stdout={p.stdout}\nstderr={p.stderr}"
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "bench_smoke"
+    from bench import SMOKE_STAGES
+
+    assert set(SMOKE_STAGES) <= set(doc["stages"])
+    assert sum(doc["stall"]["secret"].values()) == 100
+    # both exports landed and parse
+    trace_doc = json.loads(trace_out.read_text())
+    assert any(e["ph"] == "X" for e in trace_doc["traceEvents"])
+    metrics_doc = json.loads(metrics_out.read_text())
+    assert metrics_doc["spans"]["secret.dispatch"]["count"] >= 1
+
+
+def test_bench_smoke_rejects_flag_without_value():
+    """--trace-out with no value must exit 2 with a usage error, not
+    traceback (and must not swallow the next flag as its value)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for argv in (
+        ["--smoke", "--trace-out"],
+        ["--smoke", "--trace-out", "--metrics-out", "/tmp/x.json"],
+    ):
+        p = subprocess.run(
+            [sys.executable, "bench.py", *argv],
+            capture_output=True, text=True, env=env, cwd="/root/repo",
+            timeout=120,
+        )
+        assert p.returncode == 2, (argv, p.returncode, p.stderr)
+        assert "requires a file path" in p.stderr
+
+
+@pytest.mark.slow
+def test_bench_smoke_fails_loudly_when_stage_missing(tmp_path, monkeypatch):
+    """A declared stage with zero spans must fail the smoke, not pass
+    quietly."""
+    import bench
+
+    monkeypatch.setattr(
+        bench, "SMOKE_STAGES", bench.SMOKE_STAGES + ("secret.nonexistent",)
+    )
+    rc = bench.smoke()
+    assert rc == 1

@@ -200,12 +200,9 @@ def test_dedup_disabled_still_parity(cpu):
 
 
 def test_trace_counters_surface_in_report(tpu):
-    from trivy_tpu import trace
+    from trivy_tpu import obs
 
-    trace.reset()
-    was_enabled = trace._enabled
-    trace.enable()
-    try:
+    with obs.scan_context(name="dedup-test", enabled=True) as ctx:
         # identical multi-chunk files: the second's rows dedup/coalesce
         files = [
             ("src/a.txt", b"plain text content\n" * 400),
@@ -213,10 +210,7 @@ def test_trace_counters_surface_in_report(tpu):
         ]
         list(tpu.scan_files(files))
         out = io.StringIO()
-        trace.report(out)
-        text = out.getvalue()
-        assert "secret.bytes_uploaded" in text
-        assert "secret.bytes_dedup_hit" in text
-    finally:
-        trace._enabled = was_enabled
-        trace.reset()
+        ctx.report(out)
+    text = out.getvalue()
+    assert "secret.bytes_uploaded" in text
+    assert "secret.bytes_dedup_hit" in text

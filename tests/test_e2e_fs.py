@@ -149,6 +149,61 @@ def test_cli_version_and_convert(tree, tmp_path):
     assert "github-pat" in p.stdout
 
 
+def test_cli_trace_outputs(tree, tmp_path):
+    """--trace prints the span table + stall-attribution verdict; --trace-out
+    writes a Perfetto-loadable Chrome trace with >= 4 distinct stage tracks;
+    --metrics-out writes the aggregate JSON. Backend auto exercises the
+    device (XLA-on-CPU) secret pipeline so the secret.* stages record."""
+    import re
+
+    trace_file = tmp_path / "trace.json"
+    metrics_file = tmp_path / "metrics.json"
+    p = run_cli(
+        "fs", "--scanners", "secret", "--backend", "auto", "--format", "json",
+        "--trace", "--trace-out", str(trace_file),
+        "--metrics-out", str(metrics_file),
+        "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    assert p.returncode == 0, p.stderr
+    # span table with histogram columns
+    assert "-- trace" in p.stderr and "p95" in p.stderr
+    # stall-attribution verdict for the secret pipeline, summing to 100%
+    m = re.search(r"^secret: (.+)$", p.stderr, re.MULTILINE)
+    assert m, p.stderr
+    pcts = [int(x) for x in re.findall(r"(\d+)%", m.group(1))]
+    assert sum(pcts) == 100
+    # chrome trace: loadable, with one named track per stage
+    doc = json.loads(trace_file.read_text())
+    tracks = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert len(tracks) >= 4, tracks
+    assert {"secret.dispatch", "secret.device_wait", "secret.confirm"} <= tracks
+    assert all(
+        e["ts"] >= 0 and e["dur"] >= 0
+        for e in doc["traceEvents"]
+        if e["ph"] == "X"
+    )
+    # metrics json: spans + counters + stall, and the scan found the secret
+    mdoc = json.loads(metrics_file.read_text())
+    assert mdoc["spans"]["secret.dispatch"]["count"] >= 1
+    assert mdoc["counters"]["secret.bytes_uploaded"] > 0
+    assert sum(mdoc["stall"]["secret"].values()) == 100
+
+
+def test_trace_off_records_nothing(tree, tmp_path):
+    """Without --trace, scans run with span recording off: no trace block
+    on stderr (the <1%-overhead-off acceptance path)."""
+    p = run_cli(
+        "fs", "--scanners", "secret", "--backend", "cpu", "--format", "json",
+        "--cache-dir", str(tmp_path / "cache"), str(tree),
+    )
+    assert p.returncode == 0, p.stderr
+    assert "-- trace" not in p.stderr
+
+
 def test_walker_skips(tmp_path):
     from trivy_tpu.fanal.walker import FSWalker, WalkOption
 

@@ -24,10 +24,81 @@ def server(tmp_path):
 
 
 def test_healthz_and_version(server):
+    # healthz reports version, uptime, and in-flight count, not a bare "ok"
     with urllib.request.urlopen(f"{server}/healthz") as r:
-        assert r.read() == b"ok"
+        doc = json.loads(r.read())
+    assert doc["Status"] == "ok"
+    assert doc["Version"]
+    assert doc["UptimeSeconds"] >= 0
+    assert doc["InFlight"] == 0
     with urllib.request.urlopen(f"{server}/version") as r:
         assert json.loads(r.read())["Version"]
+
+
+def test_metrics_endpoint(server, tmp_path):
+    """GET /metrics serves Prometheus text fed from the scan registry."""
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+
+    root = tmp_path / "m"
+    (root / "etc").mkdir(parents=True)
+    (root / "etc" / "os-release").write_text('ID=alpine\nVERSION_ID=3.18.4\n')
+    cache = RemoteCache(server)
+    artifact = LocalFSArtifact(str(root), cache, ArtifactOption(backend="cpu"))
+    Scanner(artifact, RemoteDriver(server)).scan_artifact(
+        ScanOptions(scanners=["vuln"])
+    )
+    req = urllib.request.urlopen(f"{server}/metrics")
+    assert req.headers["Content-Type"].startswith("text/plain")
+    text = req.read().decode()
+    assert "trivy_tpu_scans_total 1" in text
+    assert "trivy_tpu_requests_in_flight 0" in text
+    assert 'trivy_tpu_http_requests_total{method="scan",code="200"} 1' in text
+    assert "trivy_tpu_scan_seconds_count 1" in text
+    # MissingBlobs ran at least once during the client flow
+    assert "trivy_tpu_cache_hits_total" in text
+    assert "trivy_tpu_cache_misses_total" in text
+    assert "trivy_tpu_secret_dedup_bytes_total" in text
+    # per-stage latency histograms fed from the scan's trace context
+    assert 'trivy_tpu_stage_seconds_count{stage="driver.apply_layers"} 1' in text
+    assert 'trivy_tpu_stage_seconds_count{stage="driver.detect_vulns"} 1' in text
+
+
+def test_concurrent_scans_disjoint_trace_contexts(tmp_path):
+    """Two concurrent ScanServer.scan calls must record into disjoint
+    per-request trace contexts (the old global span table interleaved)."""
+    import threading
+
+    from trivy_tpu import obs
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.rpc.server import ScanServer
+
+    server = ScanServer(new_cache("memory", None))
+    seen: list = []
+    barrier = threading.Barrier(2)
+
+    def fake_scan(target, artifact_id, blob_ids, options):
+        ctx = obs.current()
+        ctx.count("probe")
+        barrier.wait(timeout=5)  # both scans are mid-flight together
+        seen.append(ctx)
+        return [], None
+
+    server.driver.scan = fake_scan
+    threads = [
+        threading.Thread(target=server.scan, args=({"Target": f"t{i}"},))
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(seen) == 2
+    assert seen[0] is not seen[1]
+    assert seen[0].trace_id != seen[1].trace_id
+    assert seen[0].counters == {"probe": 1}
+    assert seen[1].counters == {"probe": 1}
+    # both scans fed the shared registry
+    assert server.metrics.scans.value() == 2
 
 
 def test_client_server_fs_scan(server, tmp_path):
@@ -122,7 +193,7 @@ def test_cli_client_server_round_trip(tmp_path):
         for _ in range(100):  # poll healthz like the reference tests
             try:
                 with urllib.request.urlopen(f"{base}/healthz", timeout=1) as r:
-                    if r.read() == b"ok":
+                    if json.loads(r.read()).get("Status") == "ok":
                         break
             except Exception:
                 time.sleep(0.1)

@@ -13,7 +13,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
-from trivy_tpu import log
+from trivy_tpu import log, obs
 from trivy_tpu.cache.key import calc_blob_key, calc_key
 from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
 from trivy_tpu.fanal.handler import HandlerManager
@@ -88,18 +88,32 @@ class LocalFSArtifact:
         result = AnalysisResult()
         post_files: dict = {}
         n_files = 0
+        n_analyzed = [0]  # mutable: read by the heartbeat thread
+        ctx = obs.current()
 
-        def analyze(rel, info, opener):
-            wanted = self.group.analyze_file(result, self.root, rel, info, opener)
+        def analyze(rel, info, fut):
+            def load():
+                # time blocked on the read-ahead pool: if this dominates,
+                # the scan is I/O-bound, not analyzer/device-bound
+                with ctx.span("fs.read_wait"):
+                    return fut.result()
+
+            wanted = self.group.analyze_file(result, self.root, rel, info, load)
             for t, content in wanted.items():
                 post_files.setdefault(t, {})[rel] = content
+            n_analyzed[0] += 1
 
         # overlap file reads with analysis: a reader pool prefetches contents
         # ahead of the (serial) analyzer loop — the TPU-era equivalent of the
         # reference's per-file goroutine fan-out (ref: analyzer.go:403-455),
         # restructured as read-ahead feeding batched device collection
         workers = self.option.parallel or DEFAULT_PARALLEL
-        with ThreadPoolExecutor(max_workers=workers) as pool:
+        with obs.heartbeat(
+            logger,
+            f"fs scan of {self.root}",
+            interval=30.0,
+            progress=lambda: f"{n_analyzed[0]} files analyzed",
+        ), ThreadPoolExecutor(max_workers=workers) as pool:
             window: deque = deque()  # (rel, info, future)
             buffered = 0
             for rel, info, opener in self.walker.walk(self.root):
@@ -112,11 +126,13 @@ class LocalFSArtifact:
                 ):
                     r, i, fut = window.popleft()
                     buffered -= i.size
-                    analyze(r, i, fut.result)
+                    analyze(r, i, fut)
             while window:
                 r, i, fut = window.popleft()
-                analyze(r, i, fut.result)
-        self.group.finalize(result, post_files)
+                analyze(r, i, fut)
+            # batched analyzers hit the device here (secret/license batches)
+            with ctx.span("fs.batch_analyze"):
+                self.group.finalize(result, post_files)
         blob = result.to_blob_info()
         self.handlers.post_handle(result, blob)
         blob_dict = blob.to_dict()

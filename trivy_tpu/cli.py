@@ -49,7 +49,17 @@ def global_flags() -> FlagGroup:
             Flag("timeout", default=300, value_type=int, config_name="timeout",
                  help="scan timeout seconds (ref default 5m)"),
             Flag("trace", default=False, value_type=bool, config_name="trace",
-                 help="print per-stage timing spans after the scan"),
+                 help="print per-stage timing spans, histograms, and the "
+                      "stall-attribution verdict after the scan"),
+            Flag("trace-out", default=None, config_name="trace.out",
+                 help="write spans as Chrome trace-event JSON (Perfetto-"
+                      "loadable; implies span recording)"),
+            Flag("metrics-out", default=None, config_name="trace.metrics-out",
+                 help="write aggregate span/counter metrics as JSON "
+                      "(implies span recording)"),
+            Flag("log-format", default="plain", choices=["plain", "json"],
+                 config_name="log.format",
+                 help="log line format: plain, or one JSON object per line"),
         ],
     )
 
@@ -421,7 +431,11 @@ def main(argv: list[str] | None = None) -> int:
         opts = resolve_all(groups, ns, config)
     except (ValueError, FileNotFoundError) as e:
         parser.error(str(e))
-    log.init(debug=opts.get("debug", False), quiet=opts.get("quiet", False))
+    log.init(
+        debug=opts.get("debug", False),
+        quiet=opts.get("quiet", False),
+        fmt=opts.get("log_format") or "plain",
+    )
 
     from trivy_tpu.commands import run
 

@@ -139,47 +139,63 @@ def run(command: str, ns, opts) -> int:
         signal.alarm(timeout)
     from trivy_tpu.result import IgnorePolicy, PolicyError
 
-    from trivy_tpu import trace
+    from trivy_tpu import obs
 
-    if opts.get("trace"):
-        trace.enable()
-    try:
-        # validate the ignore policy up front: a broken policy file must not
-        # cost the user a full scan before failing
-        if opts.get("ignore_policy"):
-            IgnorePolicy(opts["ignore_policy"])
-        if command in ("fs", "rootfs", "repo"):
-            return _run_fs_like(command, ns, opts)
-        if command == "image":
-            return _run_image(ns, opts)
-        if command == "vm":
-            return _run_vm(ns, opts)
-        if command == "sbom":
-            return _run_sbom(ns, opts)
-        if command == "convert":
-            return _run_convert(ns, opts)
-        if command == "server":
-            return _run_server(ns, opts)
-        if command == "clean":
-            return _run_clean(ns, opts)
-        raise ValueError(f"unknown command {command}")
-    except TimeoutError as e:
-        logger.error("%s", e)
-        return 1
-    except PolicyError as e:
-        logger.error("%s", e)
-        return 2
-    except ModuleNotFoundError as e:
-        if (e.name or "").startswith("trivy_tpu"):
-            logger.error(
-                "this feature is not implemented yet (missing %s)", e.name
-            )
+    # every run gets its own trace context (contextvar-scoped): back-to-back
+    # run() calls in one process and concurrent library scans record into
+    # disjoint tables instead of one global one. Span recording turns on
+    # for --trace and whenever an export destination is given.
+    trace_on = bool(
+        opts.get("trace") or opts.get("trace_out") or opts.get("metrics_out")
+    )
+    with obs.scan_context(name=command, enabled=trace_on or None) as ctx:
+        try:
+            # validate the ignore policy up front: a broken policy file must
+            # not cost the user a full scan before failing
+            if opts.get("ignore_policy"):
+                IgnorePolicy(opts["ignore_policy"])
+            if command in ("fs", "rootfs", "repo"):
+                return _run_fs_like(command, ns, opts)
+            if command == "image":
+                return _run_image(ns, opts)
+            if command == "vm":
+                return _run_vm(ns, opts)
+            if command == "sbom":
+                return _run_sbom(ns, opts)
+            if command == "convert":
+                return _run_convert(ns, opts)
+            if command == "server":
+                return _run_server(ns, opts)
+            if command == "clean":
+                return _run_clean(ns, opts)
+            raise ValueError(f"unknown command {command}")
+        except TimeoutError as e:
+            logger.error("%s", e)
+            return 1
+        except PolicyError as e:
+            logger.error("%s", e)
             return 2
-        raise
-    finally:
-        if timeout > 0 and command != "server":
-            signal.alarm(0)
-        trace.report()
+        except ModuleNotFoundError as e:
+            if (e.name or "").startswith("trivy_tpu"):
+                logger.error(
+                    "this feature is not implemented yet (missing %s)", e.name
+                )
+                return 2
+            raise
+        finally:
+            if timeout > 0 and command != "server":
+                signal.alarm(0)
+            if ctx.enabled:
+                from trivy_tpu.obs import export
+
+                if opts.get("trace"):
+                    ctx.report()
+                if opts.get("trace_out"):
+                    export.write_chrome_trace(ctx, opts["trace_out"])
+                    logger.info("chrome trace written to %s", opts["trace_out"])
+                if opts.get("metrics_out"):
+                    export.write_metrics_json(ctx, opts["metrics_out"])
+                    logger.info("metrics written to %s", opts["metrics_out"])
 
 
 def _emit(report, ns, opts) -> int:

@@ -11,10 +11,11 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from collections.abc import Callable, Iterator
 from dataclasses import dataclass, field
 
-from trivy_tpu import log
+from trivy_tpu import log, obs
 
 logger = log.logger("walker")
 
@@ -83,6 +84,26 @@ class FSWalker:
         self.opt = option or WalkOption()
 
     def walk(self, root: str) -> Iterator[tuple[str, FileInfo, Callable[[], bytes]]]:
+        """Walk with per-file timing: when the active trace context is
+        enabled, the time spent producing each next entry (scandir, stat,
+        skip filtering) records as ``walk.next`` spans plus a ``walk.files``
+        counter — the walk's own track in the scan trace."""
+        ctx = obs.current()
+        if not ctx.enabled:
+            yield from self._walk(root)
+            return
+        it = self._walk(root)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            ctx.add("walk.next", time.perf_counter() - t0)
+            ctx.count("walk.files")
+            yield item
+
+    def _walk(self, root: str) -> Iterator[tuple[str, FileInfo, Callable[[], bytes]]]:
         root = os.path.abspath(root)
         skip_dirs = list(self.opt.skip_dirs) + DEFAULT_SKIP_DIRS
         skip_files = list(self.opt.skip_files)

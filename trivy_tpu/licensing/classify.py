@@ -304,8 +304,10 @@ class LicenseClassifier:
         """
         from collections import deque
 
+        from trivy_tpu import obs
         from trivy_tpu.ops import ngram_score as ng
 
+        ctx = obs.current()
         if not hasattr(self, "_gate_keys"):
             self._build_scoring()
         scorer = self._device_scorer()
@@ -328,7 +330,8 @@ class LicenseClassifier:
 
         def fetch_gate() -> None:
             dev, rows_p, tis = pending.popleft()
-            counts = np.asarray(dev)[: len(tis)]
+            with ctx.span("license.device_wait"):
+                counts = np.asarray(dev)[: len(tis)]
             sel = np.nonzero(counts > 0)[0]
             if len(sel):
                 T = rows_p.shape[1]
@@ -361,7 +364,9 @@ class LicenseClassifier:
                     rows[off : off + MAX_DEVICE_ROWS],
                     bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
                 )
-                pending.append((scorer.gate(part), part, part_t))
+                with ctx.span("license.dispatch"):
+                    pending.append((scorer.gate(part), part, part_t))
+                ctx.sample("license.queue_depth", len(pending))
                 if len(pending) >= DEVICE_PIPELINE_DEPTH:
                     fetch_gate()
         while pending:
@@ -377,8 +382,9 @@ class LicenseClassifier:
         def fetch_score() -> None:
             dev, tis = spending.popleft()
             fw_d, pp_d = dev
-            fw_np = np.asarray(fw_d, dtype=np.float64)
-            pp_np = np.asarray(pp_d, dtype=np.float64)
+            with ctx.span("license.device_wait"):
+                fw_np = np.asarray(fw_d, dtype=np.float64)
+                pp_np = np.asarray(pp_d, dtype=np.float64)
             for i, ti in enumerate(tis.tolist()):
                 acc[ti] = (fw_np[i, :L], pp_np[i, :L])
 
@@ -391,7 +397,9 @@ class LicenseClassifier:
                     rows[off : off + MAX_DEVICE_ROWS],
                     bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
                 )
-                spending.append((scorer(part), part_t))
+                with ctx.span("license.dispatch"):
+                    spending.append((scorer(part), part_t))
+                ctx.sample("license.queue_depth", len(spending))
                 if len(spending) >= DEVICE_PIPELINE_DEPTH:
                     fetch_score()
         while spending:
@@ -459,35 +467,36 @@ class LicenseClassifier:
                         if li not in by_text.get(ti, ()) and ph in get_norm(ti):
                             by_text.setdefault(ti, set()).add(li)
 
-        for ti, cands in by_text.items():
-            if ti in overflow_set:
-                continue  # already resolved by the host oracle
-            norm = get_norm(ti)
-            fw_row, pp_row = acc.get(ti, (zero_row, zero_row))
-            grams = None  # host int64 grams, computed only if needed
-            scored: list[tuple[float, float, str]] = []
-            for li in cands:
-                lic = self.licenses[li]
-                shorts = self._phrase_short[lic]
-                got_short = (
-                    sum(1 for p in shorts if p in norm) if shorts else 0
-                )
-                nu = int(n_units[li])
-                conf_p = (pp_row[li] + got_short) / nu if nu else 0.0
-                cf = fw_row[li] / wtot[li] if wtot[li] > 0 else 0.0
-                conf = max(cf, conf_p)
-                if abs(conf - self.confidence) <= EPS:
-                    # float32 device sums can land a hair on either side
-                    # of the threshold: settle the call with the exact
-                    # host scorer (rare — only threshold-grazing texts)
-                    if grams is None:
-                        grams = self._text_grams(norm)
-                    conf, matched_w = self._score(li, norm, grams)
-                    if conf >= self.confidence:
-                        scored.append((conf, matched_w, lic))
-                elif conf >= self.confidence:
-                    scored.append((float(conf), float(fw_row[li]), lic))
-            out[ti] = self._rank_findings(scored)
+        with ctx.span("license.finalize"):
+            for ti, cands in by_text.items():
+                if ti in overflow_set:
+                    continue  # already resolved by the host oracle
+                norm = get_norm(ti)
+                fw_row, pp_row = acc.get(ti, (zero_row, zero_row))
+                grams = None  # host int64 grams, computed only if needed
+                scored: list[tuple[float, float, str]] = []
+                for li in cands:
+                    lic = self.licenses[li]
+                    shorts = self._phrase_short[lic]
+                    got_short = (
+                        sum(1 for p in shorts if p in norm) if shorts else 0
+                    )
+                    nu = int(n_units[li])
+                    conf_p = (pp_row[li] + got_short) / nu if nu else 0.0
+                    cf = fw_row[li] / wtot[li] if wtot[li] > 0 else 0.0
+                    conf = max(cf, conf_p)
+                    if abs(conf - self.confidence) <= EPS:
+                        # float32 device sums can land a hair on either side
+                        # of the threshold: settle the call with the exact
+                        # host scorer (rare — only threshold-grazing texts)
+                        if grams is None:
+                            grams = self._text_grams(norm)
+                        conf, matched_w = self._score(li, norm, grams)
+                        if conf >= self.confidence:
+                            scored.append((conf, matched_w, lic))
+                    elif conf >= self.confidence:
+                        scored.append((float(conf), float(fw_row[li]), lic))
+                out[ti] = self._rank_findings(scored)
         return out
 
     def _device_scorer(self):

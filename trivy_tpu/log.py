@@ -3,13 +3,17 @@
 Mirrors the reference's slog wrapper (ref: pkg/log/logger.go:20-28): a thin
 layer over :mod:`logging` with per-subsystem prefixes, ``--debug``/``--quiet``
 switches, and deferred configuration so library code can log before the CLI
-has parsed flags.
+has parsed flags. ``--log-format json`` swaps the formatter for one JSON
+object per line (ts/level/subsystem/msg) so server-mode logs are
+machine-parseable; plain stays the default.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
+import time
 
 _ROOT_NAME = "trivy_tpu"
 _configured = False
@@ -21,16 +25,49 @@ def logger(prefix: str | None = None) -> logging.Logger:
     return logging.getLogger(name)
 
 
-def init(debug: bool = False, quiet: bool = False, stream=None) -> None:
+class _JSONFormatter(logging.Formatter):
+    """One JSON object per line: {"ts", "level", "subsystem", "msg"}."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        subsystem = record.name
+        if subsystem.startswith(_ROOT_NAME):
+            subsystem = subsystem[len(_ROOT_NAME):].lstrip(".") or "root"
+        doc = {
+            # UTC with an explicit Z: collectors correlating logs across
+            # hosts must not have to guess the zone
+            "ts": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int((record.created % 1) * 1000):03d}Z",
+            "level": record.levelname,
+            "subsystem": subsystem,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc)
+
+
+def init(
+    debug: bool = False,
+    quiet: bool = False,
+    stream=None,
+    fmt: str = "plain",
+) -> None:
     """Configure the root framework logger once (idempotent re-config allowed)."""
     global _configured
     root = logging.getLogger(_ROOT_NAME)
     for h in list(root.handlers):
         root.removeHandler(h)
     handler = logging.StreamHandler(stream if stream is not None else sys.stderr)
-    handler.setFormatter(
-        logging.Formatter("%(asctime)s %(levelname)s [%(name)s] %(message)s", "%H:%M:%S")
-    )
+    if fmt == "json":
+        handler.setFormatter(_JSONFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter(
+                "%(asctime)s %(levelname)s [%(name)s] %(message)s", "%H:%M:%S"
+            )
+        )
     root.addHandler(handler)
     if quiet:
         root.setLevel(logging.ERROR)

@@ -65,35 +65,42 @@ class MisconfScanner:
         return c.id not in self._disabled and c.avd_id not in self._disabled
 
     def scan_files(self, files: list[tuple[str, bytes]]) -> list[Misconfiguration]:
-        from trivy_tpu import trace
+        from trivy_tpu import obs
 
-        with trace.span("misconf.scan_files"):
+        with obs.span("misconf.scan_files"):
             return self._scan_files(files)
 
     def _scan_files(self, files: list[tuple[str, bytes]]) -> list[Misconfiguration]:
+        from trivy_tpu import obs
+
+        ctx = obs.current()
         tf_files: dict[str, bytes] = {}
         helm_files: dict[str, bytes] = {}
         per_file: list[tuple[str, str, bytes]] = []
-        for path, content in files:
-            try:
-                ftype = detection.detect_type(path, content)
-            except Exception as e:  # one bad file must not kill the batch
-                logger.debug("misconf type detection failed for %s: %s", path, e)
-                continue
-            if ftype is None:
-                continue
-            if self.option.file_types and ftype not in self.option.file_types:
-                continue
-            if ftype == detection.FILE_TYPE_TERRAFORM:
-                tf_files[path] = content
-            elif ftype == detection.FILE_TYPE_HELM:
-                helm_files[path] = content
-            else:
-                per_file.append((path, ftype, content))
+        with ctx.span("misconf.parse"):
+            for path, content in files:
+                try:
+                    ftype = detection.detect_type(path, content)
+                except Exception as e:  # one bad file must not kill the batch
+                    logger.debug(
+                        "misconf type detection failed for %s: %s", path, e
+                    )
+                    continue
+                if ftype is None:
+                    continue
+                if self.option.file_types and ftype not in self.option.file_types:
+                    continue
+                if ftype == detection.FILE_TYPE_TERRAFORM:
+                    tf_files[path] = content
+                elif ftype == detection.FILE_TYPE_HELM:
+                    helm_files[path] = content
+                else:
+                    per_file.append((path, ftype, content))
 
         out: list[Misconfiguration] = []
         if tf_files:
-            out.extend(self._scan_terraform(tf_files))
+            with ctx.span("misconf.terraform"):
+                out.extend(self._scan_terraform(tf_files))
         if helm_files:
             # charts are more than their templates: Chart.yaml/values.yaml
             # carry no {{ }} so they type as plain yaml — hand every
@@ -136,11 +143,13 @@ class MisconfScanner:
                 for path, ftype, content in per_file
                 if not _chart_owned(path, ftype)
             ]
-            out.extend(self._scan_helm(helm_files))
-        for path, ftype, content in per_file:
-            mc = self.scan_file(path, content, ftype)
-            if mc is not None:
-                out.append(mc)
+            with ctx.span("misconf.helm"):
+                out.extend(self._scan_helm(helm_files))
+        with ctx.span("misconf.eval"):
+            for path, ftype, content in per_file:
+                mc = self.scan_file(path, content, ftype)
+                if mc is not None:
+                    out.append(mc)
         out = [mc for mc in out if mc.failures or mc.successes]
         out.sort(key=lambda m: m.file_path)
         return out

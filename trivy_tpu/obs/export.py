@@ -1,0 +1,130 @@
+"""Trace/metrics file export.
+
+- :func:`write_chrome_trace` — Chrome trace-event JSON (the format Perfetto
+  and ``chrome://tracing`` load): one ``X`` complete event per span, one
+  named track per pipeline stage (and per device stream — mesh dispatch
+  spans are named per device), thread_name metadata events labeling tracks.
+- :func:`write_metrics_json` — the aggregate view: per-stage histograms
+  (count/total/mean/p50/p95/max), counters, sample stats, and the stall-
+  attribution verdict. ``bench.py`` embeds this dict into BENCH reps.
+"""
+
+from __future__ import annotations
+
+import json
+
+from trivy_tpu.obs import TraceContext
+from trivy_tpu.obs import stall as _stall
+
+
+def chrome_trace_events(ctx: TraceContext) -> list[dict]:
+    """Flatten a context into trace-event dicts (sorted by start time)."""
+    events: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": f"trivy-tpu {ctx.name} [{ctx.trace_id}]"},
+        }
+    ]
+    # track per (stage, thread): a stage whose spans run concurrently in N
+    # threads (the confirm pool) gets N tracks ("stage", "stage #2", ...)
+    # instead of one track with overlapping slices Perfetto would mangle
+    tids: dict[tuple[str, int], int] = {}
+    per_stage_threads: dict[str, int] = {}
+
+    def tid_for(name: str, thread: int) -> int:
+        key = (name, thread)
+        t = tids.get(key)
+        if t is None:
+            t = tids[key] = len(tids) + 1
+            n = per_stage_threads[name] = per_stage_threads.get(name, 0) + 1
+            label = name if n == 1 else f"{name} #{n}"
+            events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 1,
+                    "tid": t,
+                    "args": {"name": label},
+                }
+            )
+        return t
+
+    with ctx._lock:
+        spans = list(ctx.events)
+    for sp in sorted(spans, key=lambda s: s.start):
+        args = {"trace_id": ctx.trace_id, "span_id": sp.span_id}
+        if sp.parent_id is not None:
+            args["parent_span_id"] = sp.parent_id
+        events.append(
+            {
+                "name": sp.name,
+                "cat": sp.name.split(".", 1)[0],
+                "ph": "X",
+                "pid": 1,
+                "tid": tid_for(sp.name, sp.thread),
+                # clamp: add()-style backdated spans can start a hair
+                # before the context's own creation timestamp
+                "ts": max(0.0, round((sp.start - ctx.created) * 1e6, 3)),
+                "dur": round(sp.duration * 1e6, 3),
+                "args": args,
+            }
+        )
+    return events
+
+
+def write_chrome_trace(ctx: TraceContext, dest) -> None:
+    """Write Perfetto-loadable trace-event JSON to a path or file object."""
+    doc = {
+        "traceEvents": chrome_trace_events(ctx),
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": ctx.trace_id,
+            "name": ctx.name,
+            "dropped_events": ctx.dropped_events,
+        },
+    }
+    if hasattr(dest, "write"):
+        json.dump(doc, dest)
+    else:
+        with open(dest, "w") as f:
+            json.dump(doc, f)
+
+
+def metrics_dict(ctx: TraceContext) -> dict:
+    """Aggregate metrics as one JSON-serializable dict."""
+    with ctx._lock:
+        counters = dict(sorted(ctx.counters.items()))
+        samples = {
+            k: (v[0], v[1], v[2]) for k, v in sorted(ctx.samples.items())
+        }
+    return {
+        "trace_id": ctx.trace_id,
+        "name": ctx.name,
+        "spans": {
+            name: {k: round(v, 6) for k, v in s.items()}
+            for name, s in ctx.stage_stats().items()
+        },
+        "counters": counters,
+        "samples": {
+            name: {
+                "count": count,
+                "mean": round(total / max(1, count), 3),
+                "max": vmax,
+            }
+            for name, (count, total, vmax) in samples.items()
+            if count
+        },
+        "stall": _stall.attribution(ctx),
+        "dropped_events": ctx.dropped_events,
+    }
+
+
+def write_metrics_json(ctx: TraceContext, dest) -> None:
+    if hasattr(dest, "write"):
+        json.dump(metrics_dict(ctx), dest, indent=2)
+    else:
+        with open(dest, "w") as f:
+            json.dump(metrics_dict(ctx), f, indent=2)

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from trivy_tpu import obs
+
 try:  # jax >= 0.5 top-level spelling
     _shard_map = jax.shard_map
 except AttributeError:
@@ -99,7 +101,13 @@ def round_robin_match_fn(match_fn, devices=None, rows_multiple: int = 1):
             state["next"] = (i + 1) % len(devices)
         if rows_multiple > 1:
             chunks = pad_batch(chunks, rows_multiple)
-        return fn(jax.device_put(chunks, devices[i]))
+        # per-stream span: each device stream gets its own trace track, so
+        # a Perfetto view shows whether transfers actually interleave
+        ctx = obs.current()
+        with ctx.span(f"mesh.d{i}.dispatch"):
+            out = fn(jax.device_put(chunks, devices[i]))
+        ctx.count(f"mesh.d{i}.batches")
+        return out
 
     run.n_streams = len(devices)
     run.devices = devices

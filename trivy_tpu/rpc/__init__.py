@@ -15,6 +15,7 @@ CACHE_MISSING_BLOBS = "/twirp/trivy.cache.v1.Cache/MissingBlobs"
 CACHE_DELETE_BLOBS = "/twirp/trivy.cache.v1.Cache/DeleteBlobs"
 HEALTHZ = "/healthz"
 VERSION = "/version"
+METRICS = "/metrics"
 
 # ref: pkg/flag/server_flags.go default token header
 DEFAULT_TOKEN_HEADER = "Trivy-Token"

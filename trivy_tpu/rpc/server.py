@@ -1,9 +1,14 @@
 """Scan server (ref: pkg/rpc/server/listen.go, server.go).
 
 Serves the Cache and Scanner services over HTTP with optional token-header
-auth and /healthz + /version probes. Detection runs server-side against the
-server's cache + advisory DB; analysis stays client-side (ref:
-pkg/commands/artifact/run.go:348-355 split).
+auth, /healthz + /version probes, and a Prometheus-text ``GET /metrics``
+surface (scan counts, per-stage latency histograms fed from each scan's
+trace context, cache hit/miss, dedup bytes, in-flight gauge). Every
+Scanner.Scan request runs in its own trace context — concurrent scans
+record into disjoint span tables — and long scans emit heartbeat progress
+logs. Detection runs server-side against the server's cache + advisory DB;
+analysis stays client-side (ref: pkg/commands/artifact/run.go:348-355
+split).
 """
 
 from __future__ import annotations
@@ -11,12 +16,17 @@ from __future__ import annotations
 import hmac
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from trivy_tpu import log, rpc
+from trivy_tpu import log, obs, rpc
+from trivy_tpu.obs import metrics as obs_metrics
 from trivy_tpu.scanner import ScanOptions
 
 logger = log.logger("rpc:server")
+
+# progress-log cadence for long-running server scans
+HEARTBEAT_SECS = 30.0
 
 # request-body ceiling; blobs are analysis metadata, not file contents, so
 # 256 MiB is generous headroom while bounding a hostile Content-Length
@@ -79,6 +89,62 @@ class DBReloader:
             self._cond.notify_all()
 
 
+class ServerMetrics:
+    """The server's Prometheus registry plus its standard instruments."""
+
+    def __init__(self):
+        r = self.registry = obs_metrics.Registry()
+        self.scans = r.counter(
+            "trivy_tpu_scans_total", "Completed Scanner.Scan requests"
+        )
+        self.scan_seconds = r.histogram(
+            "trivy_tpu_scan_seconds", "Scanner.Scan wall time",
+            buckets=obs_metrics.SCAN_BUCKETS,
+        )
+        self.stage_seconds = r.histogram(
+            "trivy_tpu_stage_seconds",
+            "Per-pipeline-stage span latency, fed from scan trace contexts",
+            labelnames=("stage",),
+            buckets=obs_metrics.SCAN_BUCKETS,
+        )
+        self.requests = r.counter(
+            "trivy_tpu_http_requests_total",
+            "RPC requests by service method and status code",
+            labelnames=("method", "code"),
+        )
+        self.request_seconds = r.histogram(
+            "trivy_tpu_http_request_seconds", "RPC request wall time",
+            labelnames=("method",),
+        )
+        self.in_flight = r.gauge(
+            "trivy_tpu_requests_in_flight", "RPC requests currently executing"
+        )
+        self.cache_hits = r.counter(
+            "trivy_tpu_cache_hits_total",
+            "Blob IDs requested via MissingBlobs that were already cached",
+        )
+        self.cache_misses = r.counter(
+            "trivy_tpu_cache_misses_total",
+            "Blob IDs requested via MissingBlobs that were absent",
+        )
+        self.dedup_bytes = r.counter(
+            "trivy_tpu_secret_dedup_bytes_total",
+            "Corpus bytes resolved from the secret chunk-dedup hit cache",
+        )
+
+    def observe_scan(self, ctx, seconds: float) -> None:
+        """Fold one finished scan's trace context into the registry.
+        snapshot() is reservoir-bounded: per-stage histogram counts are
+        exact up to obs.RESERVOIR spans per stage per scan and a uniform
+        sample beyond."""
+        self.scans.inc()
+        self.scan_seconds.observe(seconds)
+        for stage, durs in ctx.snapshot().items():
+            for d in durs:
+                self.stage_seconds.observe(d, stage=stage)
+        self.dedup_bytes.inc(ctx.counters.get("secret.bytes_dedup_hit", 0))
+
+
 class ScanServer:
     """Service implementation bound to a cache and a local driver."""
 
@@ -88,6 +154,8 @@ class ScanServer:
         self.cache = cache
         self.driver = LocalDriver(cache, vuln_client=vuln_client)
         self.reloader: DBReloader | None = None
+        self.metrics = ServerMetrics()
+        self.started = time.time()
 
     # -- service methods (JSON dict in/out) ---------------------------------
 
@@ -96,12 +164,23 @@ class ScanServer:
             scanners=req.get("Options", {}).get("Scanners", ["vuln"]),
             list_all_pkgs=bool(req.get("Options", {}).get("ListAllPkgs")),
         )
-        results, os_info = self.driver.scan(
-            req.get("Target", ""),
-            req.get("ArtifactID", ""),
-            list(req.get("BlobIDs", [])),
-            options,
-        )
+        target = req.get("Target", "")
+        # per-request trace context: concurrent scans record into disjoint
+        # tables (each handler thread carries its own contextvar value), and
+        # the aggregates feed the shared /metrics registry afterwards
+        with obs.scan_context(name=f"server-scan:{target}", enabled=True) as ctx:
+            with obs.heartbeat(
+                logger, f"scan of {target or '<unnamed>'}", HEARTBEAT_SECS
+            ):
+                t0 = time.perf_counter()
+                results, os_info = self.driver.scan(
+                    target,
+                    req.get("ArtifactID", ""),
+                    list(req.get("BlobIDs", [])),
+                    options,
+                )
+                dt = time.perf_counter() - t0
+            self.metrics.observe_scan(ctx, dt)
         return {
             "OS": os_info.to_dict() if os_info else None,
             "Results": [r.to_dict() for r in results],
@@ -116,9 +195,12 @@ class ScanServer:
         return {}
 
     def missing_blobs(self, req: dict) -> dict:
+        blob_ids = list(req.get("BlobIDs", []))
         missing_artifact, missing = self.cache.missing_blobs(
-            req.get("ArtifactID", ""), list(req.get("BlobIDs", []))
+            req.get("ArtifactID", ""), blob_ids
         )
+        self.metrics.cache_hits.inc(len(blob_ids) - len(missing))
+        self.metrics.cache_misses.inc(len(missing))
         return {"MissingArtifact": missing_artifact, "MissingBlobIDs": missing}
 
     def delete_blobs(self, req: dict) -> dict:
@@ -147,6 +229,7 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
         def _reply(self, code: int, payload: dict) -> None:
             import gzip as _gzip
 
+            self._status = code
             body = json.dumps(payload).encode()
             accepts_gzip = "gzip" in self.headers.get("Accept-Encoding", "")
             self.send_response(code)
@@ -158,20 +241,38 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply_text(self, code: int, body: bytes, content_type: str) -> None:
+            self._status = code
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):
             if self.path == rpc.HEALTHZ:
-                # plain "ok" like the reference's healthz
-                body = b"ok"
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                from trivy_tpu import __version__
+
+                # liveness plus the numbers an operator checks first:
+                # version, uptime, and the in-flight request count
+                self._reply(200, {
+                    "Status": "ok",
+                    "Version": __version__,
+                    "UptimeSeconds": round(time.time() - server.started, 1),
+                    "InFlight": int(server.metrics.in_flight.value()),
+                })
                 return
             if self.path == rpc.VERSION:
                 from trivy_tpu import __version__
 
                 self._reply(200, {"Version": __version__})
+                return
+            if self.path == rpc.METRICS:
+                self._reply_text(
+                    200,
+                    server.metrics.registry.render().encode(),
+                    obs_metrics.CONTENT_TYPE,
+                )
                 return
             self._reply(404, {"error": "not found"})
 
@@ -186,6 +287,10 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             ):
                 self._reply(401, {"error": "invalid token"})
                 return
+            m = server.metrics
+            m.in_flight.inc()
+            self._status = 0
+            t0 = time.perf_counter()
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 if length < 0 or length > MAX_REQUEST_BYTES:
@@ -218,6 +323,12 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             except Exception as e:
                 logger.warning("rpc %s failed: %s", self.path, e)
                 self._reply(500, {"error": str(e)})
+            finally:
+                m.in_flight.dec()
+                m.requests.inc(method=method, code=str(self._status))
+                m.request_seconds.observe(
+                    time.perf_counter() - t0, method=method
+                )
 
     return Handler
 

@@ -7,7 +7,7 @@ Consumes cache keys only: applies layers, then assembles per-class results
 
 from __future__ import annotations
 
-from trivy_tpu import log
+from trivy_tpu import log, obs
 from trivy_tpu.fanal.applier import apply_layers
 from trivy_tpu.scanner import ScanOptions
 from trivy_tpu.types import (
@@ -30,17 +30,22 @@ class LocalDriver:
     def scan(
         self, target: str, artifact_id: str, blob_ids: list[str], options: ScanOptions
     ) -> tuple[list[Result], OS | None]:
-        blobs = []
-        for bid in blob_ids:
-            d = self.cache.get_blob(bid)
-            if d is None:
-                raise KeyError(f"blob missing from cache: {bid}")
-            blobs.append(BlobInfo.from_dict(d))
-        detail = apply_layers(blobs)
+        ctx = obs.current()
+        with ctx.span("driver.apply_layers"):
+            blobs = []
+            for bid in blob_ids:
+                d = self.cache.get_blob(bid)
+                if d is None:
+                    raise KeyError(f"blob missing from cache: {bid}")
+                blobs.append(BlobInfo.from_dict(d))
+            detail = apply_layers(blobs)
         results: list[Result] = []
 
         if "vuln" in options.scanners and self.vuln_client is not None:
-            results.extend(self._scan_vulnerabilities(target, detail, options))
+            with ctx.span("driver.detect_vulns"):
+                results.extend(
+                    self._scan_vulnerabilities(target, detail, options)
+                )
         elif options.list_all_pkgs:
             # package inventory without detection (SBOM output paths)
             results.extend(self._package_results(target, detail))

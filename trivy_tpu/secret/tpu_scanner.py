@@ -58,7 +58,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from trivy_tpu import log, trace
+from trivy_tpu import log, obs
 from trivy_tpu.ops.match import build_match_fn
 from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
 from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
@@ -325,7 +325,7 @@ class TpuSecretScanner:
 
     # -- core batching loop -------------------------------------------------
 
-    def _device_loop(self, in_q, out_q) -> None:
+    def _device_loop(self, in_q, out_q, ctx) -> None:
         """Single device thread: dispatch batches asynchronously, defer the
         blocking result fetch until the pipeline is full.
 
@@ -337,38 +337,46 @@ class TpuSecretScanner:
         and fetches don't interleave across threads (measured: the
         two-thread pipeline retains ~0.9 byte/byte scanned; this loop with
         identical depth is flat).
+
+        Stall instrumentation (all on ``ctx``, the spawning scan's trace
+        context — this thread outlives the contextvar scope):
+        ``secret.feed_wait`` is time blocked on the host feed (feed-starved),
+        ``secret.dispatch`` the enqueue/transfer handoff (upload-bound),
+        ``secret.device_wait`` the blocking result fetch (device-bound).
         """
         pending: deque = deque()
 
         def fetch_oldest():
             dev, meta = pending.popleft()
-            with trace.span("secret.device_wait"):
+            with ctx.span("secret.device_wait"):
                 out_q.put((np.asarray(dev), meta))
 
-        try:
-            while True:
-                item = in_q.get()
-                if item is None:
-                    break
-                batch, meta = item
-                with trace.span("secret.dispatch"):
-                    pending.append((self._match(batch), meta))
-                if len(pending) >= self._pipeline_depth:
+        with obs.activate(ctx):
+            try:
+                while True:
+                    with ctx.span("secret.feed_wait"):
+                        item = in_q.get()
+                    if item is None:
+                        break
+                    batch, meta = item
+                    with ctx.span("secret.dispatch"):
+                        pending.append((self._match(batch), meta))
+                    if len(pending) >= self._pipeline_depth:
+                        fetch_oldest()
+                while pending:
                     fetch_oldest()
-            while pending:
-                fetch_oldest()
-        except BaseException as e:  # device/tunnel failure: surface it
-            # the feeder sees the exception on its next drain and raises;
-            # empty the queue first so a feeder blocked on a full in_q
-            # wakes up (its batches are lost — the scan is failing anyway)
-            while True:
-                try:
-                    in_q.get_nowait()
-                except queue.Empty:
-                    break
-            out_q.put(e)
-            return
-        out_q.put(None)
+            except BaseException as e:  # device/tunnel failure: surface it
+                # the feeder sees the exception on its next drain and raises;
+                # empty the queue first so a feeder blocked on a full in_q
+                # wakes up (its batches are lost — the scan is failing anyway)
+                while True:
+                    try:
+                        in_q.get_nowait()
+                    except queue.Empty:
+                        break
+                out_q.put(e)
+                return
+            out_q.put(None)
 
     def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
         """Scan many files; yields per-file results in input order."""
@@ -379,6 +387,10 @@ class TpuSecretScanner:
         next_emit = 0
         total = 0
         stats = self.stats
+        # capture the caller's trace context once: the device thread and
+        # confirm pool record into it via obs.activate (worker threads do
+        # not inherit the contextvar)
+        ctx = obs.current()
         chunk_len = self.chunk_len
         dedup = self._dedup
         fp_key = self.ruleset_fingerprint
@@ -407,7 +419,7 @@ class TpuSecretScanner:
         in_q: queue.Queue = queue.Queue(maxsize=self._pipeline_depth)
         out_q: queue.Queue = queue.Queue()
         device_thread = threading.Thread(
-            target=self._device_loop, args=(in_q, out_q), daemon=True
+            target=self._device_loop, args=(in_q, out_q, ctx), daemon=True
         )
         device_thread.start()
         # backpressure: bounds queued+running confirms so a slow confirm
@@ -417,7 +429,8 @@ class TpuSecretScanner:
 
         def confirm_task(st: _FileState) -> Secret:
             try:
-                return self._confirm(st)
+                with obs.activate(ctx), ctx.span("secret.confirm"):
+                    return self._confirm(st)
             finally:
                 confirm_slots.release()
 
@@ -475,7 +488,8 @@ class TpuSecretScanner:
                 return
             n = next(b for b in self._buckets if b >= len(meta))
             stats.add(bytes_uploaded=n * chunk_len)
-            trace.count("secret.bytes_uploaded", n * chunk_len)
+            ctx.count("secret.bytes_uploaded", n * chunk_len)
+            ctx.sample("secret.queue_depth", in_q.qsize())
             in_q.put((buf[:n], meta))
             meta = []
             # rotate to the next ring buffer; full rows are overwritten on
@@ -508,14 +522,14 @@ class TpuSecretScanner:
                 cached = self._hit_get(key)
                 if cached is not None:
                     stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
-                    trace.count("secret.bytes_dedup_hit", nbytes)
+                    ctx.count("secret.bytes_dedup_hit", nbytes)
                     apply_hits(segs, cached)
                     return
                 waiting = inflight.get(key)
                 if waiting is not None:
                     waiting.append(segs)
                     stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
-                    trace.count("secret.bytes_dedup_hit", nbytes)
+                    ctx.count("secret.bytes_dedup_hit", nbytes)
                     return
                 inflight[key] = []
             row = buf[len(meta)]
@@ -527,7 +541,7 @@ class TpuSecretScanner:
                     stats.add(
                         rows_packed=1, files_packed=len(segs), bytes_packed=nbytes
                     )
-                    trace.count("secret.bytes_packed", nbytes)
+                    ctx.count("secret.bytes_packed", nbytes)
             else:
                 piece = parts[0][1]
                 row[: len(piece)] = piece
@@ -667,8 +681,9 @@ class TpuSecretScanner:
     # -- host confirmation --------------------------------------------------
 
     def _confirm(self, st: _FileState) -> Secret:
-        with trace.span("secret.confirm"):
-            return self._confirm_inner(st)
+        # span recording happens in scan_files' confirm_task (which holds
+        # the scan's trace context); direct callers time themselves
+        return self._confirm_inner(st)
 
     def _confirm_inner(self, st: _FileState) -> Secret:
         windows_by_id = {

@@ -1,98 +1,46 @@
-"""Lightweight tracing surface (the ``--trace`` analog).
+"""Compat shim over :mod:`trivy_tpu.obs` (the old flat-span-table surface).
 
-The reference's only tracing is rego evaluation traces plumbed through an
-io.Writer (ref: pkg/iac/rego/options.go:34-35, pkg/misconf ScannerOption
-Trace). Here spans time the batched pipelines (device dispatch, host
-confirm, misconf evaluation, walk) and ``report()`` prints an aggregate
-table — the per-batch timing surface SURVEY §5 asks for.
-
-Disabled (zero overhead beyond one bool check) unless ``enable()`` runs,
-which the ``--trace`` flag does.
+The global span table this module used to own is gone: spans now live on
+per-scan :class:`trivy_tpu.obs.TraceContext` objects carried in a
+contextvar, so back-to-back ``commands.run`` calls and concurrent
+server-mode scans no longer accumulate into one process-global dict.
+These functions keep the historical call-site spelling and route to the
+*current* context — new code should import :mod:`trivy_tpu.obs` directly.
 """
 
 from __future__ import annotations
 
-import sys
-import threading
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-
-_enabled = False
-_lock = threading.Lock()
-_spans: dict[str, list[float]] = defaultdict(list)
-_counters: dict[str, int] = defaultdict(int)
+from trivy_tpu import obs
 
 
 def enable() -> None:
-    global _enabled
-    _enabled = True
+    obs.enable()
+
+
+def disable() -> None:
+    obs.disable()
 
 
 def enabled() -> bool:
-    return _enabled
+    return obs.enabled()
 
 
 def reset() -> None:
-    with _lock:
-        _spans.clear()
-        _counters.clear()
+    obs.current().reset()
 
 
-@contextmanager
 def span(name: str):
     """Time a block under ``name``; no-op when tracing is off."""
-    if not _enabled:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            _spans[name].append(dt)
+    return obs.span(name)
 
 
 def add(name: str, seconds: float) -> None:
-    if _enabled:
-        with _lock:
-            _spans[name].append(seconds)
+    obs.add(name, seconds)
 
 
 def count(name: str, n: int = 1) -> None:
-    """Accumulate an integer counter (byte/item tallies, e.g. the secret
-    feed path's bytes_packed / bytes_uploaded / bytes_dedup_hit); no-op
-    when tracing is off."""
-    if _enabled:
-        with _lock:
-            _counters[name] += n
+    obs.count(name, n)
 
 
 def report(out=None) -> None:
-    """Aggregate span table (count / total / mean), widest totals first,
-    followed by the integer counters."""
-    if not _enabled:
-        return
-    out = out or sys.stderr
-    with _lock:
-        rows = [
-            (name, len(times), sum(times))
-            for name, times in _spans.items()
-        ]
-        counters = sorted(_counters.items())
-    if not rows and not counters:
-        return
-    rows.sort(key=lambda r: -r[2])
-    out.write("\n-- trace " + "-" * 51 + "\n")
-    if rows:
-        out.write(f"{'span':<38}{'count':>7}{'total':>10}{'mean':>10}\n")
-        for name, cnt, total in rows:
-            out.write(
-                f"{name:<38}{cnt:>7}{total:>9.3f}s{total / cnt:>9.4f}s\n"
-            )
-    if counters:
-        out.write(f"{'counter':<45}{'value':>15}\n")
-        for name, value in counters:
-            out.write(f"{name:<45}{value:>15}\n")
-    out.write("-" * 60 + "\n")
+    obs.report(out)
