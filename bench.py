@@ -578,6 +578,78 @@ def bench_streaming(scanner, rng, total_mb=None) -> dict:
     }
 
 
+def bench_chaos(rng) -> dict:
+    """Chaos rep: one scripted device fault mid-rep (faults.py, so the
+    failure is deterministic and replayable). Asserts the per-batch retry
+    ladder RECOVERS — findings byte-identical to the exact host engine and
+    no degradation to the host fallback — then reports the recovery
+    counters. RuntimeErrors here fail the ``--chaos`` gate."""
+    from trivy_tpu import faults
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    # small batches so the corpus spans enough dispatches for a mid-rep
+    # fault (and a later OOM-shaped one) to land on live traffic
+    scanner = TpuSecretScanner(batch_size=16)
+    files = make_corpus(8, rng)
+    warm_buckets(scanner)
+    s0 = scanner.stats.snapshot()
+    t0 = time.perf_counter()
+    faults.configure("device.dispatch:at=3:times=2,device.dispatch:at=7:error=oom")
+    try:
+        got = list(scanner.scan_files(files))
+    finally:
+        faults.clear()
+    dt = time.perf_counter() - t0
+    s1 = scanner.stats.snapshot()
+    host = scanner.exact
+    n_findings = 0
+    for (path, data), secret in zip(files, got):
+        want = [f.to_dict() for f in host.scan_bytes(path, data).findings]
+        if [f.to_dict() for f in secret.findings] != want:
+            raise RuntimeError(f"chaos-rep findings mismatch for {path}")
+        n_findings += len(secret.findings)
+    retries = s1["batch_retries"] - s0["batch_retries"]
+    splits = s1["batch_splits"] - s0["batch_splits"]
+    degraded = s1["degraded"] - s0["degraded"]
+    if degraded:
+        raise RuntimeError(
+            "chaos rep degraded to the host fallback; the per-batch retry "
+            "ladder should have absorbed a transient fault"
+        )
+    if retries < 1 or splits < 1:
+        raise RuntimeError(
+            f"chaos rep did not exercise the ladder (retries={retries}, "
+            f"splits={splits}); the injected faults missed live traffic"
+        )
+    total_bytes = sum(len(d) for _, d in files)
+    return {
+        "metric": "chaos_recovery",
+        "value": round(total_bytes / dt / (1024 * 1024), 2),
+        "unit": "MB/s",
+        "detail": {
+            "corpus_mb": round(total_bytes / (1024 * 1024), 1),
+            "batch_retries": retries,
+            "batch_splits": splits,
+            "degraded": bool(degraded),
+            "findings": n_findings,
+            "parity": "ok",
+        },
+    }
+
+
+def chaos() -> int:
+    """``bench.py --chaos``: the recovery gate, wired like ``--smoke`` —
+    exits 1 unless the injected mid-rep fault recovers with parity."""
+    rng = np.random.default_rng(13)
+    try:
+        out = bench_chaos(rng)
+    except RuntimeError as e:
+        print(f"FATAL: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(out))
+    return 0
+
+
 # stages every smoke rep must record: a refactor that silently drops
 # instrumentation from the secret feed path (the spans the stall verdict
 # and the perf rounds depend on) fails the smoke loudly instead of
@@ -668,6 +740,7 @@ def main():
         ("cve_match_rate", lambda: bench_cve(rng)),
         ("cached_image_layer_rate", bench_image_layers),
         ("streaming_scan_throughput", _run_streaming_child),
+        ("chaos_recovery", lambda: bench_chaos(rng)),
     ):
         try:
             extra_metrics.append(fn())
@@ -737,5 +810,7 @@ if __name__ == "__main__":
             return sys.argv[i]
 
         sys.exit(smoke(_opt("--trace-out"), _opt("--metrics-out")))
+    elif "--chaos" in sys.argv:
+        sys.exit(chaos())
     else:
         main()

@@ -57,6 +57,26 @@ def test_bench_smoke_rejects_flag_without_value():
 
 
 @pytest.mark.slow
+def test_bench_chaos_recovers_with_parity():
+    """bench.py --chaos: one scripted device fault mid-rep; the gate exits
+    0 only when the retry ladder recovers with findings parity and no
+    host-fallback degradation."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--chaos"],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=600,
+    )
+    assert p.returncode == 0, f"stdout={p.stdout}\nstderr={p.stderr}"
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "chaos_recovery"
+    assert doc["detail"]["parity"] == "ok"
+    assert doc["detail"]["batch_retries"] >= 1
+    assert doc["detail"]["batch_splits"] >= 1
+    assert doc["detail"]["degraded"] is False
+
+
+@pytest.mark.slow
 def test_bench_smoke_fails_loudly_when_stage_missing(tmp_path, monkeypatch):
     """A declared stage with zero spans must fail the smoke, not pass
     quietly."""

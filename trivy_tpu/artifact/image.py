@@ -23,7 +23,12 @@ import tarfile
 from trivy_tpu import log
 from trivy_tpu.artifact.local_fs import DEFAULT_PARALLEL, ArtifactOption
 from trivy_tpu.cache.key import calc_key
-from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
+from trivy_tpu.fanal.analyzer import (
+    AnalyzerGroup,
+    AnalyzerOptions,
+    AnalysisResult,
+    note_file_skipped,
+)
 from trivy_tpu.fanal.handler import HandlerManager
 from trivy_tpu.fanal.walker_tar import LayerResult, LayerTarWalker
 from trivy_tpu.types import ArtifactReference, BlobInfo
@@ -203,7 +208,13 @@ class ImageArchiveArtifact:
             stream = archive.layer_stream(index)
             try:
                 for rel, info, opener in self.walker.walk(stream, layer_res):
-                    wanted = group.analyze_file(result, "", rel, info, opener)
+                    try:
+                        wanted = group.analyze_file(result, "", rel, info, opener)
+                    except OSError as e:
+                        # truncated/unreadable layer entry: skip the file,
+                        # count it, keep walking the layer
+                        note_file_skipped(rel, e)
+                        continue
                     for t, content in wanted.items():
                         post_files.setdefault(t, {})[rel] = content
             finally:

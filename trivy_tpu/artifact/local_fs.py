@@ -15,7 +15,12 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import log, obs
 from trivy_tpu.cache.key import calc_blob_key, calc_key
-from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
+from trivy_tpu.fanal.analyzer import (
+    AnalyzerGroup,
+    AnalyzerOptions,
+    AnalysisResult,
+    note_file_skipped,
+)
 from trivy_tpu.fanal.handler import HandlerManager
 from trivy_tpu.fanal.walker import FSWalker, WalkOption
 from trivy_tpu.types import ArtifactReference
@@ -98,7 +103,15 @@ class LocalFSArtifact:
                 with ctx.span("fs.read_wait"):
                     return fut.result()
 
-            wanted = self.group.analyze_file(result, self.root, rel, info, load)
+            try:
+                wanted = self.group.analyze_file(
+                    result, self.root, rel, info, load
+                )
+            except OSError as e:
+                # TOCTOU: the file vanished (or turned unreadable) between
+                # the walk and the read — skip it, count it, keep scanning
+                note_file_skipped(rel, e)
+                return
             for t, content in wanted.items():
                 post_files.setdefault(t, {})[rel] = content
             n_analyzed[0] += 1

@@ -15,7 +15,12 @@ import os
 from trivy_tpu import log
 from trivy_tpu.artifact.local_fs import ArtifactOption
 from trivy_tpu.cache.key import calc_key
-from trivy_tpu.fanal.analyzer import AnalyzerGroup, AnalyzerOptions, AnalysisResult
+from trivy_tpu.fanal.analyzer import (
+    AnalyzerGroup,
+    AnalyzerOptions,
+    AnalysisResult,
+    note_file_skipped,
+)
 from trivy_tpu.fanal.handler import HandlerManager
 from trivy_tpu.fanal.vm import walk_disk
 from trivy_tpu.fanal.walker import FileInfo
@@ -83,7 +88,11 @@ class VMImageArtifact:
                 continue
             n_files += 1
             info = FileInfo(size=size, mode=0o644)
-            wanted = self.group.analyze_file(result, "", fpath, info, opener)
+            try:
+                wanted = self.group.analyze_file(result, "", fpath, info, opener)
+            except OSError as e:
+                note_file_skipped(fpath, e)
+                continue
             for t, content in wanted.items():
                 post_files.setdefault(t, {})[fpath] = content
         self.group.finalize(result, post_files)

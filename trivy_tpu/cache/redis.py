@@ -8,6 +8,14 @@ client over a plain socket speaking exactly the commands the cache needs
 works against any Redis-compatible server — and against the in-process
 fake RESP server the tests run (same zero-egress technique as the
 registry/daemon fakes).
+
+Failure domain: the cache is an accelerator, not a correctness dependency
+— a dropped Redis connection mid-scan must not kill the scan. Every
+command gets ONE reconnect-and-replay attempt (all cache commands are
+idempotent); if that also fails the instance degrades to an in-memory
+backend for the rest of its life (log-once, ``trivy_tpu_cache_degraded``
+gauge on ``GET /metrics``, ``cache.degraded`` scan-health event) instead
+of raising out of ``_get``/``_set``.
 """
 
 from __future__ import annotations
@@ -17,16 +25,27 @@ import socket
 import ssl
 import urllib.parse
 
-from trivy_tpu import log
+from trivy_tpu import faults, log, obs
+from trivy_tpu.obs import metrics as obs_metrics
 
 logger = log.logger("cache:redis")
 
 ARTIFACT_PREFIX = "fanal::artifact::"
 BLOB_PREFIX = "fanal::blob::"
 
+_CACHE_DEGRADED = obs_metrics.REGISTRY.gauge(
+    "trivy_tpu_cache_degraded",
+    "1 while the redis scan cache has degraded to the in-memory backend",
+)
+
 
 class RedisError(ConnectionError):
     pass
+
+
+class RedisConnectionError(RedisError):
+    """Transport-level failure (dropped/closed connection) — retriable by
+    reconnect, unlike a server ``-ERR`` reply."""
 
 
 class _Resp:
@@ -47,7 +66,7 @@ class _Resp:
     def _reply(self):
         line = self.rfile.readline()
         if not line:
-            raise RedisError("connection closed by redis server")
+            raise RedisConnectionError("connection closed by redis server")
         kind, rest = line[:1], line[1:-2]
         if kind == b"+":
             return rest.decode()
@@ -97,20 +116,34 @@ class RedisCache:
         if u.scheme not in ("redis", "rediss"):
             raise ValueError(f"not a redis URL: {url}")
         self.ttl = int(ttl)
+        self._url = u
+        self._ca_cert = ca_cert
+        self._client_cert = client_cert
+        self._client_key = client_key
+        self._timeout = timeout
+        self._insecure = insecure_skip_verify
+        self._mem = None  # in-memory fallback, set once degraded
+        self._connect()
+        # a fresh healthy connection clears the process-level degraded
+        # signal a previous instance may have left behind
+        _CACHE_DEGRADED.set(0)
+
+    def _connect(self) -> None:
+        u = self._url
         host = u.hostname or "localhost"
         port = u.port or 6379
-        sock = socket.create_connection((host, port), timeout=timeout)
-        if u.scheme == "rediss" or ca_cert or client_cert:
+        sock = socket.create_connection((host, port), timeout=self._timeout)
+        if u.scheme == "rediss" or self._ca_cert or self._client_cert:
             # default context = system trust roots + hostname verification;
             # a shared scan cache carries poisoning risk, so certificate
             # checks are only dropped behind the explicit insecure flag
             # (never silently, as rediss:// without --redis-ca once did)
             ctx = ssl.create_default_context(
-                cafile=ca_cert or None
+                cafile=self._ca_cert or None
             )
-            if client_cert:
-                ctx.load_cert_chain(client_cert, client_key or None)
-            if insecure_skip_verify:
+            if self._client_cert:
+                ctx.load_cert_chain(self._client_cert, self._client_key or None)
+            if self._insecure:
                 ctx.check_hostname = False
                 ctx.verify_mode = ssl.CERT_NONE
             sock = ctx.wrap_socket(sock, server_hostname=host)
@@ -125,17 +158,69 @@ class RedisCache:
             self._resp.command("SELECT", db)
         self._resp.command("PING")
 
+    # -- resilience ------------------------------------------------------
+
+    def _cmd(self, *args):
+        """One command with a single reconnect-and-replay on a dropped
+        connection (cache commands are idempotent). Raises on the second
+        transport failure — the caller's degrade wrapper takes over."""
+        try:
+            return self._resp.command(*args)
+        except (RedisConnectionError, OSError) as e:
+            if isinstance(e, RedisError) and not isinstance(e, RedisConnectionError):
+                raise  # server -ERR reply (OOM/LOADING/...), not a transport failure
+            logger.warning(
+                "redis connection lost (%s); reconnecting once", e
+            )
+            try:
+                self._resp.close()
+            except OSError:
+                pass
+            self._connect()  # raises OSError when the server is really gone
+            return self._resp.command(*args)
+
+    def _degrade(self, err: Exception) -> None:
+        from trivy_tpu.cache.memory import MemoryCache
+
+        self._mem = MemoryCache()
+        logger.warning(
+            "redis cache unavailable (%s); degrading to the in-memory "
+            "backend for the rest of this scan", err,
+        )
+        _CACHE_DEGRADED.set(1)
+        obs.health_count("cache.degraded")
+
+    @property
+    def degraded(self) -> bool:
+        return self._mem is not None
+
+    def _do(self, redis_op, mem_op):
+        """Run against redis, or against the in-memory fallback once
+        degraded. The first unrecoverable transport failure flips this
+        instance to the fallback permanently (log-once)."""
+        if self._mem is not None:
+            return mem_op(self._mem)
+        try:
+            return redis_op()
+        except (RedisConnectionError, OSError) as e:
+            if isinstance(e, RedisError) and not isinstance(e, RedisConnectionError):
+                raise  # command-level error: surface it, keep the connection
+            self._degrade(e)
+            return mem_op(self._mem)
+
     # -- the cache interface (FSCache-compatible) -----------------------
 
     def _set(self, key: str, obj: dict) -> None:
+        faults.check("cache.redis.set", key=key)
         data = json.dumps(obj, separators=(",", ":"))
         if self.ttl > 0:
-            self._resp.command("SET", key, data, "EX", str(self.ttl))
+            self._cmd("SET", key, data, "EX", str(self.ttl))
         else:
-            self._resp.command("SET", key, data)
+            self._cmd("SET", key, data)
 
     def _get(self, key: str) -> dict | None:
-        data = self._resp.command("GET", key)
+        faults.check("cache.redis.get", key=key)
+        data = self._cmd("GET", key)
         if data is None:
             return None
         try:
@@ -145,40 +230,63 @@ class RedisCache:
             return None
 
     def put_artifact(self, artifact_id: str, info: dict) -> None:
-        self._set(ARTIFACT_PREFIX + artifact_id, info)
+        self._do(
+            lambda: self._set(ARTIFACT_PREFIX + artifact_id, info),
+            lambda m: m.put_artifact(artifact_id, info),
+        )
 
     def put_blob(self, blob_id: str, info: dict) -> None:
-        self._set(BLOB_PREFIX + blob_id, info)
+        self._do(
+            lambda: self._set(BLOB_PREFIX + blob_id, info),
+            lambda m: m.put_blob(blob_id, info),
+        )
 
     def get_artifact(self, artifact_id: str) -> dict | None:
-        return self._get(ARTIFACT_PREFIX + artifact_id)
+        return self._do(
+            lambda: self._get(ARTIFACT_PREFIX + artifact_id),
+            lambda m: m.get_artifact(artifact_id),
+        )
 
     def get_blob(self, blob_id: str) -> dict | None:
-        return self._get(BLOB_PREFIX + blob_id)
+        return self._do(
+            lambda: self._get(BLOB_PREFIX + blob_id),
+            lambda m: m.get_blob(blob_id),
+        )
 
-    def missing_blobs(
+    def _missing_blobs_redis(
         self, artifact_id: str, blob_ids: list[str]
     ) -> tuple[bool, list[str]]:
         missing = [
             b for b in blob_ids
-            if self._resp.command("EXISTS", BLOB_PREFIX + b) == 0
+            if self._cmd("EXISTS", BLOB_PREFIX + b) == 0
         ]
         missing_artifact = (
-            self._resp.command("EXISTS", ARTIFACT_PREFIX + artifact_id) == 0
+            self._cmd("EXISTS", ARTIFACT_PREFIX + artifact_id) == 0
         )
         return missing_artifact, missing
 
+    def missing_blobs(
+        self, artifact_id: str, blob_ids: list[str]
+    ) -> tuple[bool, list[str]]:
+        return self._do(
+            lambda: self._missing_blobs_redis(artifact_id, blob_ids),
+            lambda m: m.missing_blobs(artifact_id, blob_ids),
+        )
+
     def delete_blobs(self, blob_ids: list[str]) -> None:
         if blob_ids:
-            self._resp.command(
-                "DEL", *[BLOB_PREFIX + b for b in blob_ids]
+            self._do(
+                lambda: self._cmd(
+                    "DEL", *[BLOB_PREFIX + b for b in blob_ids]
+                ),
+                lambda m: m.delete_blobs(blob_ids),
             )
 
-    def clear(self) -> None:
+    def _clear_redis(self) -> None:
         for prefix in (ARTIFACT_PREFIX, BLOB_PREFIX):
             cursor = "0"
             while True:
-                reply = self._resp.command(
+                reply = self._cmd(
                     "SCAN", cursor, "MATCH", prefix + "*", "COUNT", "100"
                 )
                 cursor = (
@@ -188,12 +296,18 @@ class RedisCache:
                 )
                 keys = reply[1] or []
                 if keys:
-                    self._resp.command(
+                    self._cmd(
                         "DEL",
                         *[k.decode() if isinstance(k, bytes) else k for k in keys],
                     )
                 if cursor == "0":
                     break
 
+    def clear(self) -> None:
+        self._do(self._clear_redis, lambda m: m.clear())
+
     def close(self) -> None:
-        self._resp.close()
+        try:
+            self._resp.close()
+        except OSError:
+            pass

@@ -60,6 +60,10 @@ def global_flags() -> FlagGroup:
             Flag("log-format", default="plain", choices=["plain", "json"],
                  config_name="log.format",
                  help="log line format: plain, or one JSON object per line"),
+            Flag("fault-inject", default=None, config_name="fault-inject",
+                 help="arm the deterministic fault-injection harness, e.g. "
+                      "'device.dispatch@d3:times=-1,cache.redis.get:at=2' "
+                      "(see trivy_tpu/faults.py for the grammar)"),
         ],
     )
 
@@ -79,6 +83,10 @@ def scan_flags() -> FlagGroup:
                  help="device backend for batched engines"),
             Flag("parallel", default=0, value_type=int, config_name="scan.parallel",
                  help="host worker count (0 = auto)"),
+            Flag("no-host-fallback", default=False, value_type=bool,
+                 config_name="scan.no-host-fallback",
+                 help="fail the scan on unrecoverable device errors instead "
+                      "of degrading to the exact host engine"),
         ],
     )
 

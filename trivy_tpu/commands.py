@@ -76,6 +76,7 @@ def _artifact_option(ns, opts):
             "java_db_path": opts.get("java_db"),
             "secret_dedup": not opts.get("no_secret_dedup"),
             "secret_pack": not opts.get("no_secret_pack"),
+            "host_fallback": not opts.get("no_host_fallback"),
             # own cache handle: the hit-vector store outlives any single
             # artifact's cache usage and redis/fs backends are cheap to dup
             "secret_hit_cache": (
@@ -148,6 +149,20 @@ def run(command: str, ns, opts) -> int:
     trace_on = bool(
         opts.get("trace") or opts.get("trace_out") or opts.get("metrics_out")
     )
+    from trivy_tpu import faults
+
+    # arm the fault-injection harness for this run (--fault-inject /
+    # TRIVY_TPU_FAULT_INJECT); disarmed again in the finally below so
+    # library callers running several commands don't leak scripted faults
+    if opts.get("fault_inject"):
+        try:
+            faults.configure(opts["fault_inject"])
+        except ValueError as e:
+            logger.error("%s", e)
+            return 2
+        logger.warning(
+            "fault injection armed: %s", opts["fault_inject"]
+        )
     with obs.scan_context(name=command, enabled=trace_on or None) as ctx:
         try:
             # validate the ignore policy up front: a broken policy file must
@@ -183,6 +198,8 @@ def run(command: str, ns, opts) -> int:
                 return 2
             raise
         finally:
+            if opts.get("fault_inject"):
+                faults.clear()
             if timeout > 0 and command != "server":
                 signal.alarm(0)
             if ctx.enabled:

@@ -38,6 +38,25 @@ from trivy_tpu.types import (
 logger = log.logger("analyzer")
 
 
+class FileReadError(OSError):
+    """The file's content could not be read (vanished or turned unreadable
+    between the walk and the read — TOCTOU). Raised out of ``analyze_file``
+    as a file-level event so the artifact layer can count the skip once,
+    instead of every analyzer logging its own failure for the same file."""
+
+
+def note_file_skipped(rel: str, err: OSError) -> None:
+    """Shared skip accounting for the artifact layers (fs/image/vm): warn,
+    bump the ``walk.skipped`` obs counter, and record the always-on health
+    event that surfaces as ``SkippedFiles`` in the report summary."""
+    from trivy_tpu import obs
+
+    logger.warning("skipping %s: unreadable (%s)", rel, err)
+    ctx = obs.current()
+    ctx.count("walk.skipped")
+    ctx.health_count("walk.skipped")
+
+
 class AnalyzerType(str, enum.Enum):
     """Analyzer type constants (subset of ref: pkg/fanal/analyzer/const.go)."""
 
@@ -304,7 +323,10 @@ class AnalyzerGroup:
         def load() -> bytes:
             nonlocal content
             if content is None:
-                content = opener()
+                try:
+                    content = opener()
+                except OSError as e:
+                    raise FileReadError(f"{file_path}: {e}") from e
             return content
 
         for a in self.analyzers:
@@ -315,6 +337,8 @@ class AnalyzerGroup:
                     AnalysisInput(dir=dir, file_path=file_path, info=info, content=load())
                 )
                 result.merge(r)
+            except FileReadError:
+                raise  # file-level: the caller counts the skip once
             except Exception as e:  # analyzer errors are logged, never fatal
                 logger.warning("analyzer %s failed on %s: %s", a.type.value, file_path, e)
         for a in self.batch_analyzers:
@@ -324,6 +348,8 @@ class AnalyzerGroup:
                 a.collect(
                     AnalysisInput(dir=dir, file_path=file_path, info=info, content=load())
                 )
+            except FileReadError:
+                raise
             except Exception as e:
                 logger.warning("collector %s failed on %s: %s", a.type.value, file_path, e)
         for a in self.post_analyzers:

@@ -67,6 +67,8 @@ class _LicenseBatchAnalyzer(BatchAnalyzer):
         self._files: list[tuple[str, str]] = []  # (path, text)
         backend = getattr(options, "backend", "auto")
         self._backend = "cpu" if backend == "cpu" else "auto"
+        extra = getattr(options, "extra", {}) or {}
+        self._host_fallback = bool(extra.get("host_fallback", True))
 
     def collect(self, inp: AnalysisInput) -> None:
         text = inp.content.decode("utf-8", "replace")
@@ -78,7 +80,9 @@ class _LicenseBatchAnalyzer(BatchAnalyzer):
         files, self._files = self._files, []
         if not files:
             return AnalysisResult()
-        clf = LicenseClassifier(backend=self._backend)
+        clf = LicenseClassifier(
+            backend=self._backend, host_fallback=self._host_fallback
+        )
         per_file = clf.classify_batch([t for _p, t in files])
         licenses = [
             LicenseFile(type=self.kind, file_path=path, findings=findings)

@@ -39,24 +39,48 @@ _scanner_cache: dict = {}
 def _shared_scanner(
     config, backend: str, parallel: int,
     dedup: bool = True, pack_small: bool = True, hit_cache=None,
+    host_fallback: bool = True,
 ):
     key = (
         id(config) if config is not None else None,
         backend, parallel, dedup, pack_small,
         id(hit_cache) if hit_cache is not None else None,
+        host_fallback,
     )
     with _scanner_lock:
         if key not in _scanner_cache:
+            init_degraded = False
             if backend == "cpu":
-                _scanner_cache[key] = SecretScanner(config)
+                scanner = SecretScanner(config)
             else:
-                from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+                try:
+                    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
 
-                _scanner_cache[key] = TpuSecretScanner(
-                    config, confirm_workers=parallel,
-                    dedup=dedup, pack_small=pack_small, hit_cache=hit_cache,
-                )
-        return _scanner_cache[key]
+                    scanner = TpuSecretScanner(
+                        config, backend=backend, confirm_workers=parallel,
+                        dedup=dedup, pack_small=pack_small,
+                        hit_cache=hit_cache, host_fallback=host_fallback,
+                    )
+                except Exception as e:
+                    # --backend failed at init (jax import, device probe,
+                    # kernel compile): the ladder's last rung applies here
+                    # too — scan on the exact host engine instead of dying
+                    if not host_fallback:
+                        raise
+                    logger.warning(
+                        "device backend %r failed to initialize (%s); "
+                        "scanning on the exact host engine", backend, e,
+                    )
+                    scanner = SecretScanner(config)
+                    init_degraded = True
+            _scanner_cache[key] = (scanner, init_degraded)
+        scanner, init_degraded = _scanner_cache[key]
+        if init_degraded:
+            # every scan served by this fallback engine is a degraded scan
+            from trivy_tpu import obs
+
+            obs.note_scan_degraded()
+        return scanner
 
 
 # ref: secret.go:28-62
@@ -112,6 +136,9 @@ class SecretAnalyzer(BatchAnalyzer):
         self._dedup = bool(extra.get("secret_dedup", True))
         self._pack = bool(extra.get("secret_pack", True))
         self._hit_cache = extra.get("secret_hit_cache")
+        # --no-host-fallback: fail the scan on device errors instead of
+        # degrading to the exact host path (CI parity gates want loud)
+        self._host_fallback = bool(extra.get("host_fallback", True))
         self._scanner = None  # built lazily so CPU-only runs never touch jax
         self._files: list[tuple[str, bytes]] = []
         self._buffered = 0
@@ -142,6 +169,7 @@ class SecretAnalyzer(BatchAnalyzer):
                 self._config, self._backend, self._parallel,
                 dedup=self._dedup, pack_small=self._pack,
                 hit_cache=self._hit_cache,
+                host_fallback=self._host_fallback,
             )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 

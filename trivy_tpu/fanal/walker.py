@@ -5,6 +5,13 @@ regular file under a root. Matches the reference's behavior: default skip
 dirs (``**/.git``, ``proc``, ``sys``, ``dev``), user skip-dirs/files with
 ``**``-style glob patterns, a 100 MB size threshold, and tolerance of
 permission errors (logged and skipped, never fatal — ref: fs.go:80-96).
+
+Unreadable or vanished entries no longer disappear silently: every
+tolerated walk/stat failure counts into ``FSWalker.skipped``, the
+``walk.skipped`` obs counter, and the always-on ``walk.skipped``
+scan-health event that surfaces as ``SkippedFiles`` in the report summary
+(read-time TOCTOU failures are counted by the artifact layer, which is
+where the read happens).
 """
 
 from __future__ import annotations
@@ -15,7 +22,7 @@ import time
 from collections.abc import Callable, Iterator
 from dataclasses import dataclass, field
 
-from trivy_tpu import log, obs
+from trivy_tpu import faults, log, obs
 
 logger = log.logger("walker")
 
@@ -82,6 +89,7 @@ class FSWalker:
 
     def __init__(self, option: WalkOption | None = None):
         self.opt = option or WalkOption()
+        self.skipped = 0  # unreadable/vanished entries in the last walk
 
     def walk(self, root: str) -> Iterator[tuple[str, FileInfo, Callable[[], bytes]]]:
         """Walk with per-file timing: when the active trace context is
@@ -105,6 +113,7 @@ class FSWalker:
 
     def _walk(self, root: str) -> Iterator[tuple[str, FileInfo, Callable[[], bytes]]]:
         root = os.path.abspath(root)
+        self.skipped = 0
         skip_dirs = list(self.opt.skip_dirs) + DEFAULT_SKIP_DIRS
         skip_files = list(self.opt.skip_files)
         for dirpath, dirnames, filenames in os.walk(root, onerror=self._on_error):
@@ -127,7 +136,7 @@ class FSWalker:
                 try:
                     st = os.lstat(full)
                 except OSError as e:
-                    logger.debug("stat failed, skipping %s: %s", rel, e)
+                    self._note_skip(rel, e)
                     continue
                 # regular files only (no symlinks/devices/sockets)
                 if not os.path.isfile(full) or os.path.islink(full):
@@ -136,13 +145,21 @@ class FSWalker:
                     logger.debug("file exceeds size threshold, skipping %s", rel)
                     continue
 
-                def opener(path=full) -> bytes:
+                def opener(path=full, rel=rel) -> bytes:
+                    faults.check("walker.read", key=rel)
                     with open(path, "rb") as f:
                         return f.read()
 
                 yield rel, FileInfo.from_stat(st), opener
 
-    @staticmethod
-    def _on_error(err: OSError) -> None:
+    def _note_skip(self, what: str, err: OSError) -> None:
+        """One tolerated walk/stat failure: never fatal, never silent."""
+        self.skipped += 1
+        ctx = obs.current()
+        ctx.count("walk.skipped")
+        ctx.health_count("walk.skipped")
+        logger.debug("skipping unreadable %s: %s", what, err)
+
+    def _on_error(self, err: OSError) -> None:
         # permission errors are tolerated (ref: fs.go:80-96)
-        logger.debug("walk error tolerated: %s", err)
+        self._note_skip(getattr(err, "filename", "") or "<dir>", err)

@@ -28,6 +28,7 @@ import threading
 
 import numpy as np
 
+from trivy_tpu import faults, log
 from trivy_tpu.licensing.corpus import (
     MIN_CONFIDENCE,
     NORMALIZED_FINGERPRINTS,
@@ -35,6 +36,8 @@ from trivy_tpu.licensing.corpus import (
     normalize,
 )
 from trivy_tpu.types import LicenseFinding
+
+logger = log.logger("license:classify")
 
 _SPDX_URL = "https://spdx.org/licenses/{}.html"
 
@@ -72,10 +75,13 @@ class LicenseClassifier:
         backend: str = "auto",
         confidence: float = MIN_CONFIDENCE,
         mesh=None,
+        host_fallback: bool = True,
     ):
         self.confidence = confidence
         self.backend = backend
         self.mesh = mesh  # optional ('data','model') mesh for sharded scoring
+        self.host_fallback = host_fallback
+        self._device_failed_logged = False
         self._scorer = None  # ops.ngram_score.DeviceScorer, built lazily
         # flat phrase table: (license, phrase, weight)
         self.licenses = sorted(NORMALIZED_FINGERPRINTS)
@@ -130,10 +136,31 @@ class LicenseClassifier:
 
     def classify_batch(self, texts: list[str]) -> list[list[LicenseFinding]]:
         if self._use_device(len(texts)):
-            return self._classify_batch_device(texts)
+            try:
+                return self._classify_batch_device(texts)
+            except Exception as e:
+                # device leg of the license pipeline failed: the host batch
+                # scorer is the parity oracle, so degrade to it instead of
+                # failing the scan (findings identical, just slower)
+                if not self.host_fallback:
+                    raise
+                self._note_device_failure(e)
         if len(texts) < 4:
             return [self.classify(t) for t in texts]
         return self._classify_batch_host(texts)
+
+    def _note_device_failure(self, err: Exception) -> None:
+        from trivy_tpu import obs
+
+        obs.current().count("license.degraded")
+        if self._device_failed_logged:
+            return  # degradation already accounted for this classifier
+        self._device_failed_logged = True
+        logger.warning(
+            "license device scoring failed (%s); degrading to the host "
+            "scorer for this classifier", err,
+        )
+        obs.note_scan_degraded()
 
     def _use_device(self, n_texts: int) -> bool:
         if self.backend == "cpu" or n_texts < DEVICE_MIN_TEXTS:
@@ -364,6 +391,7 @@ class LicenseClassifier:
                     rows[off : off + MAX_DEVICE_ROWS],
                     bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
                 )
+                faults.check("device.dispatch", key="license")
                 with ctx.span("license.dispatch"):
                     pending.append((scorer.gate(part), part, part_t))
                 ctx.sample("license.queue_depth", len(pending))
@@ -397,6 +425,7 @@ class LicenseClassifier:
                     rows[off : off + MAX_DEVICE_ROWS],
                     bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
                 )
+                faults.check("device.dispatch", key="license")
                 with ctx.span("license.dispatch"):
                     spending.append((scorer(part), part_t))
                 ctx.sample("license.queue_depth", len(spending))

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-from trivy_tpu import log
+from trivy_tpu import faults, log
 from trivy_tpu.misconf import detection
 from trivy_tpu.misconf.checks import evaluate, evaluate_cloud
 from trivy_tpu.types import Misconfiguration
@@ -147,7 +147,20 @@ class MisconfScanner:
                 out.extend(self._scan_helm(helm_files))
         with ctx.span("misconf.eval"):
             for path, ftype, content in per_file:
-                mc = self.scan_file(path, content, ftype)
+                try:
+                    faults.check("misconf.eval", key=path)
+                    mc = self.scan_file(path, content, ftype)
+                except Exception as e:
+                    # per-file failure domain: one crashing engine or check
+                    # must not kill the whole misconfig pass — count it,
+                    # log it, and keep scanning the rest
+                    logger.warning(
+                        "misconf evaluation failed for %s (skipped): %s",
+                        path, e,
+                    )
+                    ctx.count("misconf.skipped")
+                    ctx.health_count("misconf.skipped")
+                    continue
                 if mc is not None:
                     out.append(mc)
         out = [mc for mc in out if mc.failures or mc.successes]

@@ -45,7 +45,9 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "health_count",
     "heartbeat",
+    "note_scan_degraded",
     "report",
     "sample",
     "scan_context",
@@ -193,6 +195,9 @@ class TraceContext:
         self.counters: dict[str, int] = {}
         # name -> [count, sum, max, bounded raw values]
         self.samples: dict[str, list] = {}
+        # scan-health events (degradations, skipped files): recorded even
+        # with tracing off — they feed the report summary, not the trace
+        self.health: dict[str, int] = {}
         self._local = threading.local()
 
     # -- recording ----------------------------------------------------------
@@ -259,6 +264,19 @@ class TraceContext:
             if len(s[3]) < MAX_SAMPLES:
                 s[3].append(value)
 
+    def health_count(self, name: str, n: int = 1) -> None:
+        """Accumulate a scan-health event (``scan.degraded``,
+        ``walk.skipped``, ``cache.degraded``). Unlike :meth:`count` this is
+        NOT gated on ``enabled`` — degradations must reach the report
+        summary even on untraced scans. A few events per scan, so the
+        always-on cost is a dict increment."""
+        with self._lock:
+            self.health[name] = self.health.get(name, 0) + n
+
+    def health_snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self.health)
+
     def reset(self) -> None:
         with self._lock:
             self.events.clear()
@@ -266,6 +284,7 @@ class TraceContext:
             self.durations.clear()
             self.counters.clear()
             self.samples.clear()
+            self.health.clear()
 
     # -- aggregation --------------------------------------------------------
 
@@ -440,6 +459,25 @@ def add(name: str, seconds: float) -> None:
 
 def count(name: str, n: int = 1) -> None:
     current().count(name, n)
+
+
+def health_count(name: str, n: int = 1) -> None:
+    current().health_count(name, n)
+
+
+def note_scan_degraded() -> None:
+    """Record one scan-degradation event everywhere it must surface: the
+    always-on health channel (folded into the report's ``Degraded`` flag)
+    and the process-global Prometheus counter on ``GET /metrics``. Shared
+    by every rung that degrades (device loop, license scorer, backend-init
+    fallback) so the two surfaces cannot drift apart."""
+    from trivy_tpu.obs import metrics as obs_metrics
+
+    current().health_count("scan.degraded")
+    obs_metrics.REGISTRY.counter(
+        "trivy_tpu_scan_degraded_total",
+        "Scans that completed on a degraded (host-fallback) path",
+    ).inc()
 
 
 def sample(name: str, value: float) -> None:

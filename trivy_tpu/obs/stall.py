@@ -29,6 +29,7 @@ BUCKETS = {
     "device_wait": "device-bound",
     "confirm": "confirm-bound",
     "finalize": "confirm-bound",
+    "host_fallback": "confirm-bound",  # degraded-mode exact host rescans
     "parse": "parse-bound",
     "eval": "eval-bound",
 }

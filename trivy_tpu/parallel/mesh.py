@@ -11,13 +11,156 @@ shards, advisory-DB shards) that shard their lookup tables.
 from __future__ import annotations
 
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from trivy_tpu import obs
+from trivy_tpu import faults, log, obs
+from trivy_tpu.obs import metrics as obs_metrics
+
+logger = log.logger("parallel:mesh")
+
+# per-device circuit breaker defaults: a device is excluded from dispatch
+# after this many CONSECUTIVE failures, then re-probed on an exponential
+# backoff schedule (one probe dispatch at a time; success closes, failure
+# doubles the backoff)
+BREAKER_THRESHOLD = 3
+BREAKER_PROBE_BACKOFF = 1.0  # seconds until the first re-probe
+BREAKER_MAX_BACKOFF = 60.0
+# a half-open probe whose outcome is never reported (scan generator closed
+# with the probe batch still in flight) expires after this long, so the
+# device is not excluded forever on a process-cached breaker
+BREAKER_PROBE_TIMEOUT = 60.0
+
+# breaker state surfaces on the process-global registry so the scan
+# server's GET /metrics (which appends this registry) shows open breakers
+_BREAKER_OPEN = obs_metrics.REGISTRY.gauge(
+    "trivy_tpu_device_breaker_open",
+    "1 while the per-device dispatch circuit breaker is open",
+    labelnames=("device",),
+)
+_DEVICE_FAILURES = obs_metrics.REGISTRY.counter(
+    "trivy_tpu_device_failures_total",
+    "Device dispatch/fetch failures observed by the breaker",
+    labelnames=("device",),
+)
+
+
+class DevicesUnavailable(RuntimeError):
+    """Every dispatch device is circuit-broken (or the device set is empty):
+    the caller's last rung is the host fallback, not a retry."""
+
+
+class CircuitBreaker:
+    """Per-device dispatch circuit breaker.
+
+    closed -> open after ``threshold`` consecutive failures; while open the
+    device is excluded from :meth:`next_device`. After ``probe_backoff``
+    seconds one probe dispatch is allowed (half-open): success closes the
+    breaker, failure re-opens it with the backoff doubled (capped at
+    ``max_backoff``). All transitions are logged and mirrored to the
+    process-global metrics registry.
+    """
+
+    def __init__(
+        self,
+        n_devices: int,
+        threshold: int = BREAKER_THRESHOLD,
+        probe_backoff: float = BREAKER_PROBE_BACKOFF,
+        max_backoff: float = BREAKER_MAX_BACKOFF,
+        probe_timeout: float = BREAKER_PROBE_TIMEOUT,
+        clock=time.monotonic,
+        labels: list[str] | None = None,
+    ):
+        self.n = n_devices
+        self.threshold = threshold
+        self.probe_backoff = probe_backoff
+        self.max_backoff = max_backoff
+        self.probe_timeout = probe_timeout
+        self.clock = clock
+        self.labels = labels or [f"d{i}" for i in range(n_devices)]
+        self._lock = threading.Lock()
+        self._fails = [0] * n_devices  # consecutive failures
+        self._open = [False] * n_devices
+        self._open_until = [0.0] * n_devices  # next probe time while open
+        self._backoff = [probe_backoff] * n_devices
+        self._probing = [False] * n_devices  # one half-open probe at a time
+        self._probe_at = [0.0] * n_devices  # when that probe was handed out
+
+    def record_failure(self, i: int) -> None:
+        _DEVICE_FAILURES.inc(device=self.labels[i])
+        with self._lock:
+            self._fails[i] += 1
+            if self._open[i]:
+                if self._probing[i]:
+                    # failed probe: re-open with doubled backoff
+                    self._probing[i] = False
+                    self._backoff[i] = min(
+                        self._backoff[i] * 2, self.max_backoff
+                    )
+                    self._open_until[i] = self.clock() + self._backoff[i]
+                    logger.warning(
+                        "device %s probe failed; breaker re-opened for %.1fs",
+                        self.labels[i], self._backoff[i],
+                    )
+                # else: a stale in-flight batch failing after the breaker
+                # already opened — not a probe outcome, don't punish the
+                # recovery schedule for it
+            elif self._fails[i] >= self.threshold:
+                self._open[i] = True
+                self._open_until[i] = self.clock() + self._backoff[i]
+                _BREAKER_OPEN.set(1, device=self.labels[i])
+                logger.warning(
+                    "device %s breaker OPEN after %d consecutive failures; "
+                    "re-probing in %.1fs",
+                    self.labels[i], self._fails[i], self._backoff[i],
+                )
+
+    def record_success(self, i: int) -> None:
+        with self._lock:
+            was_open = self._open[i]
+            self._fails[i] = 0
+            self._open[i] = False
+            self._probing[i] = False
+            self._backoff[i] = self.probe_backoff
+        if was_open:
+            _BREAKER_OPEN.set(0, device=self.labels[i])
+            logger.info("device %s recovered; breaker closed", self.labels[i])
+
+    def next_device(self, start: int) -> int | None:
+        """First dispatchable device scanning round-robin from ``start``:
+        closed devices always qualify; an open device qualifies only when
+        its probe window has arrived and no probe is already in flight.
+        Returns None when nothing is dispatchable."""
+        now = self.clock()
+        with self._lock:
+            for off in range(self.n):
+                i = (start + off) % self.n
+                if not self._open[i]:
+                    return i
+                probe_free = (
+                    not self._probing[i]
+                    or now - self._probe_at[i] >= self.probe_timeout
+                )
+                if probe_free and now >= self._open_until[i]:
+                    # probe-due open device: take it now — waiting for "no
+                    # healthy device left" would mean a recovered device is
+                    # never probed back in while any peer stays up
+                    self._probing[i] = True
+                    self._probe_at[i] = now
+                    return i
+            return None
+
+    def is_open(self, i: int) -> bool:
+        with self._lock:
+            return self._open[i]
+
+    def open_devices(self) -> list[int]:
+        with self._lock:
+            return [i for i in range(self.n) if self._open[i]]
 
 try:  # jax >= 0.5 top-level spelling
     _shard_map = jax.shard_map
@@ -75,7 +218,9 @@ def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
     return run
 
 
-def round_robin_match_fn(match_fn, devices=None, rows_multiple: int = 1):
+def round_robin_match_fn(
+    match_fn, devices=None, rows_multiple: int = 1, breaker: CircuitBreaker | None = None
+):
     """Multi-stream dispatch: whole batches round-robin across local devices.
 
     The mesh-sharded collective splits ONE batch across devices — every
@@ -87,6 +232,15 @@ def round_robin_match_fn(match_fn, devices=None, rows_multiple: int = 1):
     each dispatch is an independent per-device program (jit compiles one
     executable per placement), and callers fetch results in dispatch order
     exactly as with the single-device path.
+
+    Failure domain: a :class:`CircuitBreaker` (``run.breaker``) excludes a
+    device from the rotation after K consecutive failures and re-probes it
+    on a backoff schedule. Dispatch-time failures are recorded here;
+    fetch-time outcomes are attributed by the caller via
+    ``run.record_result(device, ok)`` — use ``run.dispatch(chunks)`` to get
+    the ``(out, device)`` pair that makes attribution possible. When every
+    device is open, dispatch raises :class:`DevicesUnavailable` so the
+    caller can take its last rung (host fallback) instead of spinning.
     """
     devices = list(devices) if devices is not None else jax.local_devices()
     if not devices:
@@ -94,21 +248,43 @@ def round_robin_match_fn(match_fn, devices=None, rows_multiple: int = 1):
     fn = jax.jit(match_fn)
     lock = threading.Lock()
     state = {"next": 0}
+    breaker = breaker or CircuitBreaker(len(devices))
 
-    def run(chunks: np.ndarray) -> jax.Array:
+    def dispatch(chunks: np.ndarray) -> tuple[jax.Array, int]:
         with lock:
-            i = state["next"]
+            i = breaker.next_device(state["next"])
+            if i is None:
+                raise DevicesUnavailable(
+                    f"all {len(devices)} dispatch devices are circuit-broken"
+                )
             state["next"] = (i + 1) % len(devices)
         if rows_multiple > 1:
             chunks = pad_batch(chunks, rows_multiple)
         # per-stream span: each device stream gets its own trace track, so
         # a Perfetto view shows whether transfers actually interleave
         ctx = obs.current()
-        with ctx.span(f"mesh.d{i}.dispatch"):
-            out = fn(jax.device_put(chunks, devices[i]))
+        try:
+            faults.check("device.dispatch", key=f"d{i}")
+            with ctx.span(f"mesh.d{i}.dispatch"):
+                out = fn(jax.device_put(chunks, devices[i]))
+        except Exception:
+            breaker.record_failure(i)
+            raise
         ctx.count(f"mesh.d{i}.batches")
-        return out
+        return out, i
 
+    def run(chunks: np.ndarray) -> jax.Array:
+        return dispatch(chunks)[0]
+
+    def record_result(i: int, ok: bool) -> None:
+        if ok:
+            breaker.record_success(i)
+        else:
+            breaker.record_failure(i)
+
+    run.dispatch = dispatch
+    run.record_result = record_result
+    run.breaker = breaker
     run.n_streams = len(devices)
     run.devices = devices
     return run

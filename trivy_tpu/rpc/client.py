@@ -5,23 +5,30 @@ The client analyzes locally, ships blobs to the server's cache, and asks the
 server to run detection. Requests retry with exponential backoff on
 connectivity errors and 5xx — the reference retries only on
 twirp.Unavailable (ref: retry.go:17-41); connection refused / 502 / 503 /
-504 map to the same class here.
+504 map to the same class here. The backoff is full-jitter (a fleet of
+clients retrying a recovering server must not synchronize into a thundering
+herd), honors ``Retry-After`` on 503 (the server sends it while draining),
+and the whole retry loop is capped by a wall-clock deadline — 10 retries ×
+5 s of zero-jitter sleep used to stall a caller ~50 s.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import time
 import urllib.error
 import urllib.request
 
-from trivy_tpu import log, rpc
+from trivy_tpu import faults, log, rpc
 from trivy_tpu.scanner import ScanOptions
 from trivy_tpu.types import OS, Result
 
 logger = log.logger("rpc:client")
 
 MAX_RETRIES = 10  # ref: retry.go retry count
+MAX_BACKOFF = 5.0  # per-sleep cap (jittered: actual sleep ~U(0, backoff))
+RETRY_DEADLINE = 60.0  # total retry wall-clock cap per request
 _RETRYABLE_HTTP = {502, 503, 504}
 
 
@@ -30,7 +37,8 @@ class RPCError(Exception):
 
 
 def _post(base: str, path: str, payload: dict, token: str, token_header: str,
-          timeout: float, retries: int = MAX_RETRIES) -> dict:
+          timeout: float, retries: int = MAX_RETRIES,
+          deadline: float = RETRY_DEADLINE) -> dict:
     import gzip as _gzip
 
     url = base.rstrip("/") + path
@@ -39,6 +47,7 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
     # (ref: the server mux wraps handlers in gzip middleware)
     body = _gzip.compress(raw) if len(raw) > 1024 else raw
     backoff = 0.1
+    start = time.monotonic()
     last: Exception | None = None
     for attempt in range(retries + 1):
         req = urllib.request.Request(
@@ -49,7 +58,9 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
         req.add_header("Accept-Encoding", "gzip")
         if token:
             req.add_header(token_header, token)
+        retry_after: float | None = None
         try:
+            faults.check("rpc.post", key=path)
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 data = resp.read()
                 if resp.headers.get("Content-Encoding") == "gzip":
@@ -58,6 +69,13 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
         except urllib.error.HTTPError as e:
             if e.code in _RETRYABLE_HTTP and attempt < retries:
                 last = e
+                if e.code == 503:
+                    # a draining/overloaded server says when to come back
+                    try:
+                        ra = e.headers.get("Retry-After")
+                        retry_after = float(ra) if ra else None
+                    except (TypeError, ValueError):
+                        retry_after = None
             else:
                 try:
                     err_body = e.read() or b"{}"
@@ -67,13 +85,32 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
                 except Exception:
                     detail = ""
                 raise RPCError(f"{path}: HTTP {e.code} {detail}".strip()) from e
-        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+        except (
+            urllib.error.URLError, ConnectionError, TimeoutError,
+            faults.InjectedFault,  # default-kind rpc.post injections retry too
+        ) as e:
             if attempt >= retries:
                 raise RPCError(f"{path}: {e}") from e
             last = e
-        logger.debug("retrying %s after %s (attempt %d)", path, last, attempt + 1)
-        time.sleep(backoff)
-        backoff = min(backoff * 2, 5.0)
+        # full jitter: sleep ~U(0, backoff) so synchronized failures
+        # desynchronize on the first retry. Retry-After is a server-directed
+        # MINIMUM with jitter on top — sleeping it verbatim would
+        # re-synchronize every client a draining server turned away
+        if retry_after is not None:
+            delay = retry_after + random.uniform(0.0, backoff)
+        else:
+            delay = random.uniform(0.0, backoff)
+        backoff = min(backoff * 2, MAX_BACKOFF)
+        remaining = deadline - (time.monotonic() - start)
+        if remaining <= delay:
+            raise RPCError(
+                f"{path}: retry deadline ({deadline:.0f}s) exceeded: {last}"
+            ) from last
+        logger.debug(
+            "retrying %s after %s (attempt %d, sleeping %.2fs)",
+            path, last, attempt + 1, delay,
+        )
+        time.sleep(delay)
     raise RPCError(f"{path}: retries exhausted: {last}")
 
 
@@ -83,16 +120,18 @@ class RemoteCache:
 
     def __init__(self, server: str, token: str = "",
                  token_header: str = rpc.DEFAULT_TOKEN_HEADER,
-                 timeout: float = 30.0, retries: int = MAX_RETRIES):
+                 timeout: float = 30.0, retries: int = MAX_RETRIES,
+                 deadline: float = RETRY_DEADLINE):
         self.base = server if "://" in server else f"http://{server}"
         self.token = token
         self.token_header = token_header
         self.timeout = timeout
         self.retries = retries
+        self.deadline = deadline
 
     def _call(self, path: str, payload: dict) -> dict:
         return _post(self.base, path, payload, self.token, self.token_header,
-                     self.timeout, self.retries)
+                     self.timeout, self.retries, self.deadline)
 
     def put_blob(self, blob_id: str, blob: dict) -> None:
         self._call(rpc.CACHE_PUT_BLOB, {"DiffID": blob_id, "BlobInfo": blob})
@@ -124,12 +163,14 @@ class RemoteDriver:
 
     def __init__(self, server: str, token: str = "",
                  token_header: str = rpc.DEFAULT_TOKEN_HEADER,
-                 timeout: float = 300.0, retries: int = MAX_RETRIES):
+                 timeout: float = 300.0, retries: int = MAX_RETRIES,
+                 deadline: float = RETRY_DEADLINE):
         self.base = server if "://" in server else f"http://{server}"
         self.token = token or ""
         self.token_header = token_header
         self.timeout = timeout
         self.retries = retries
+        self.deadline = deadline
 
     def scan(self, target: str, artifact_id: str, blob_ids: list[str],
              options: ScanOptions):
@@ -149,6 +190,7 @@ class RemoteDriver:
             self.token_header,
             self.timeout,
             self.retries,
+            self.deadline,
         )
         results = [Result.from_dict(r) for r in resp.get("Results", [])]
         os_info = OS.from_dict(resp["OS"]) if resp.get("OS") else None

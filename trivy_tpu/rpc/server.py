@@ -156,6 +156,10 @@ class ScanServer:
         self.reloader: DBReloader | None = None
         self.metrics = ServerMetrics()
         self.started = time.time()
+        # graceful-shutdown state: while draining, /healthz reports
+        # "draining" (load balancers stop routing) and new RPC requests
+        # get 503 + Retry-After; in-flight scans run to completion
+        self.draining = False
 
     # -- service methods (JSON dict in/out) ---------------------------------
 
@@ -226,7 +230,7 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
         def log_message(self, fmt, *args):  # route through our logger
             logger.debug("%s " + fmt, self.address_string(), *args)
 
-        def _reply(self, code: int, payload: dict) -> None:
+        def _reply(self, code: int, payload: dict, headers: dict | None = None) -> None:
             import gzip as _gzip
 
             self._status = code
@@ -234,6 +238,8 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             accepts_gzip = "gzip" in self.headers.get("Accept-Encoding", "")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             if accepts_gzip and len(body) > 1024:
                 body = _gzip.compress(body)
                 self.send_header("Content-Encoding", "gzip")
@@ -254,9 +260,10 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 from trivy_tpu import __version__
 
                 # liveness plus the numbers an operator checks first:
-                # version, uptime, and the in-flight request count
+                # version, uptime, and the in-flight request count; while
+                # draining, Status flips so load balancers stop routing
                 self._reply(200, {
-                    "Status": "ok",
+                    "Status": "draining" if server.draining else "ok",
                     "Version": __version__,
                     "UptimeSeconds": round(time.time() - server.started, 1),
                     "InFlight": int(server.metrics.in_flight.value()),
@@ -268,11 +275,15 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 self._reply(200, {"Version": __version__})
                 return
             if self.path == rpc.METRICS:
-                self._reply_text(
-                    200,
-                    server.metrics.registry.render().encode(),
-                    obs_metrics.CONTENT_TYPE,
+                # server-scoped registry plus the process-global one, which
+                # carries the failure-domain gauges (device breaker state,
+                # cache degradation, degraded-scan count) — metric names
+                # are disjoint between the two
+                body = (
+                    server.metrics.registry.render()
+                    + obs_metrics.REGISTRY.render()
                 )
+                self._reply_text(200, body.encode(), obs_metrics.CONTENT_TYPE)
                 return
             self._reply(404, {"error": "not found"})
 
@@ -280,6 +291,14 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             method = _ROUTES.get(self.path)
             if method is None:
                 self._reply(404, {"error": f"no such route: {self.path}"})
+                return
+            if server.draining:
+                # the client's retry loop honors Retry-After on 503, so a
+                # rolling restart redirects traffic without failed scans
+                self._reply(
+                    503, {"error": "server is draining"},
+                    headers={"Retry-After": "1"},
+                )
                 return
             if token and not hmac.compare_digest(
                 self.headers.get(token_header, "").encode("latin-1", "replace"),
@@ -360,15 +379,54 @@ def start_server(
     httpd = ThreadingHTTPServer(
         (host, port), _make_handler(service, token, token_header)
     )
+    httpd.service = service  # the drain path and tests need the handle
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd, httpd.server_address[1]
 
 
+# in-flight scans get this long to finish after SIGTERM before the
+# listener closes under them
+DRAIN_TIMEOUT = 30.0
+
+
+def drain_and_shutdown(httpd, timeout: float = DRAIN_TIMEOUT,
+                       poll: float = 0.05) -> int:
+    """Graceful drain: flip /healthz to "draining" and 503 new RPCs (so
+    load balancers and retrying clients move on), wait up to ``timeout``
+    for in-flight requests, then stop the listener. Returns the number of
+    requests still in flight when the listener closed (0 = clean drain)."""
+    service = httpd.service
+    service.draining = True
+    logger.info("draining: refusing new requests, waiting for in-flight")
+    deadline = time.monotonic() + timeout
+    while (
+        service.metrics.in_flight.value() > 0
+        and time.monotonic() < deadline
+    ):
+        time.sleep(poll)
+    remaining = int(service.metrics.in_flight.value())
+    if remaining:
+        logger.warning(
+            "drain timeout after %.0fs: %d request(s) still in flight",
+            timeout, remaining,
+        )
+    else:
+        logger.info("drained; shutting down")
+    httpd.shutdown()
+    return remaining
+
+
 def serve(host: str, port: int, cache_dir: str | None = None,
           token: str = "", token_header: str = rpc.DEFAULT_TOKEN_HEADER,
-          db_repository: str | None = None) -> None:
-    """Blocking server entrypoint for `trivy-tpu server`."""
+          db_repository: str | None = None,
+          drain_timeout: float = DRAIN_TIMEOUT) -> None:
+    """Blocking server entrypoint for `trivy-tpu server`. SIGTERM (the
+    orchestrator's stop signal) triggers a graceful drain: /healthz flips
+    to "draining", in-flight scans finish (bounded by ``drain_timeout``),
+    then the listener closes."""
+    import signal
+
     from trivy_tpu.db import load_default_db
 
     vuln_client = load_default_db(db_repository, cache_dir)
@@ -379,8 +437,16 @@ def serve(host: str, port: int, cache_dir: str | None = None,
         token=token, token_header=token_header,
         db_reload_dir=getattr(vuln_client, "db_dir", "") or None,
     )
+    stop = threading.Event()
+
+    def on_sigterm(signum, frame):
+        logger.info("SIGTERM received; starting graceful drain")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
     logger.info("listening on %s:%d", host, actual)
     try:
-        threading.Event().wait()
+        stop.wait()
     except KeyboardInterrupt:
-        httpd.shutdown()
+        pass
+    drain_and_shutdown(httpd, timeout=drain_timeout)

@@ -30,8 +30,17 @@ class Scanner:
         self.driver = driver
 
     def scan_artifact(self, options: ScanOptions) -> Report:
+        from trivy_tpu import obs
+
+        # scan-health events (degradations, skipped files) accumulate on
+        # the active trace context even with tracing off; the before/after
+        # delta is exactly this scan's share, so back-to-back library scans
+        # sharing the process-default context stay disjoint
+        health0 = obs.current().health_snapshot()
         ref = self.artifact.inspect()
         results, os_info = self.driver.scan(ref.name, ref.id, ref.blob_ids, options)
+        health = obs.current().health_snapshot()
+        delta = {k: v - health0.get(k, 0) for k, v in health.items()}
         metadata = {
             "ImageID": ref.image_metadata.get("id", ""),
             "DiffIDs": ref.image_metadata.get("diff_ids", []),
@@ -40,12 +49,18 @@ class Scanner:
             metadata["OS"] = os_info.to_dict()
         if ref.image_metadata.get("config"):
             metadata["ImageConfig"] = ref.image_metadata["config"]
+        skipped = delta.get("walk.skipped", 0)
+        if skipped > 0:
+            metadata["SkippedFiles"] = skipped
+        if delta.get("cache.degraded", 0) > 0:
+            metadata["CacheDegraded"] = True
         return Report(
             created_at=datetime.datetime.now(datetime.timezone.utc).isoformat(),
             artifact_name=ref.name,
             artifact_type=ref.type,
             metadata=metadata,
             results=[r for r in results if not r.is_empty],
+            degraded=delta.get("scan.degraded", 0) > 0,
         )
 
 

@@ -44,6 +44,14 @@ the kernel, is the e2e ceiling):
 - **round-robin multi-stream dispatch** (parallel.mesh.round_robin_match_fn)
   sends whole batches to each local device in turn so transfers overlap
   kernels across devices, multiplying effective link bandwidth.
+
+Failure domains (README "Robustness"): a failed batch re-dispatches up to
+``batch_retries`` times (OOM-shaped errors split the batch in half
+instead), round-robin dispatch carries a per-device circuit breaker that
+excludes a dying device and re-probes it on a backoff, and when nothing
+device-side survives the scan completes on the exact host confirm path —
+the parity oracle — with findings byte-identical and the scan flagged
+degraded.
 """
 
 from __future__ import annotations
@@ -58,7 +66,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from trivy_tpu import log, obs
+from trivy_tpu import faults, log, obs
 from trivy_tpu.ops.match import build_match_fn
 from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
 from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
@@ -86,6 +94,29 @@ HIT_CACHE_ENTRIES = 1 << 16
 # bump when device-compile semantics change in a way that alters hit
 # vectors for identical (rules, chunk) inputs — invalidates persisted caches
 HIT_CACHE_VERSION = 1
+# re-dispatches allowed per failed batch before the failure escalates to
+# the scan-level fallback ladder (OOM-shaped splits don't consume this
+# budget: halving strictly shrinks the batch, so it terminates on its own)
+BATCH_RETRIES = 2
+
+# error shapes that mean "the batch was too big", answered by halving the
+# batch instead of retrying it whole (XLA/PJRT spellings + the injected one)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "resource_exhausted", "out of memory",
+                "Out of memory", "OOM")
+
+
+def _is_oom(err: BaseException) -> bool:
+    s = f"{type(err).__name__}: {err}"
+    return any(m in s for m in _OOM_MARKERS)
+
+
+class _DeviceFailed(Exception):
+    """Internal marker the device loop posts when its retry ladder is
+    exhausted; ``cause`` is the original device/tunnel error."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
 
 
 def chunk_spans(n: int, chunk_len: int, overlap: int) -> list[int]:
@@ -124,6 +155,9 @@ class ScanStats:
         "chunks_dedup_hit",  # rows served from the hit cache / coalesced
         "rows_packed",       # dispatched rows carrying >1 file segment
         "files_packed",      # files that rode a shared row
+        "batch_retries",     # failed batches re-dispatched whole
+        "batch_splits",      # OOM-shaped failures answered by halving
+        "degraded",          # scans that fell back to the exact host path
     )
 
     def __init__(self):
@@ -162,6 +196,9 @@ class TpuSecretScanner:
         hit_cache=None,  # trivy_tpu.cache backend for cross-scan persistence
         dispatch: str = "auto",  # 'auto' | 'single' | 'round_robin'
         devices=None,  # explicit device list for round-robin dispatch
+        host_fallback: bool = True,  # degrade to the exact host path on
+        # unrecoverable device failure instead of failing the scan
+        batch_retries: int = BATCH_RETRIES,
     ):
         import jax
 
@@ -225,6 +262,8 @@ class TpuSecretScanner:
         self._hit_lru_max = hit_cache_entries
         self._hit_lock = threading.Lock()
         self._hit_persist = hit_cache
+        self._host_fallback = host_fallback
+        self._batch_retries = batch_retries
         self.stats = ScanStats()
 
         from trivy_tpu.parallel.mesh import (
@@ -343,13 +382,97 @@ class TpuSecretScanner:
         ``secret.feed_wait`` is time blocked on the host feed (feed-starved),
         ``secret.dispatch`` the enqueue/transfer handoff (upload-bound),
         ``secret.device_wait`` the blocking result fetch (device-bound).
+
+        Failure domain (the per-batch rung of the ladder): a failed
+        dispatch or fetch re-dispatches that batch up to ``batch_retries``
+        times — under round-robin dispatch the retry lands on the next
+        healthy device, and the breaker's failure/success feedback is
+        recorded here. OOM-shaped errors split the batch in half instead
+        of retrying it whole (halving terminates on its own, so splits
+        don't consume the retry budget). Only when the ladder is exhausted
+        — or every device is circuit-broken — does the failure escalate to
+        ``scan_files``'s host fallback.
         """
-        pending: deque = deque()
+        from trivy_tpu.parallel.mesh import DevicesUnavailable
+
+        pending: deque = deque()  # (dev, meta, batch, device_idx, retries)
+        match = self._match
+        dispatch_fn = getattr(match, "dispatch", None)
+        record = getattr(match, "record_result", None)
+        stats = self.stats
+        chunk_len = self.chunk_len
+
+        def rebatch(batch: np.ndarray, meta: list) -> np.ndarray:
+            """Fresh bucket-padded copy of a failed batch's live rows — the
+            original may be a ring-buffer view whose slot the feeder is
+            about to refill, so retries never alias it."""
+            n = next(b for b in self._buckets if b >= len(meta))
+            out = np.zeros((n, chunk_len), dtype=np.uint8)
+            out[: len(meta)] = batch[: len(meta)]
+            return out
+
+        def recover(batch, meta, retries, err) -> list:
+            """Ladder decision for one failed batch: work items to
+            re-dispatch, or raise when the ladder is exhausted."""
+            if isinstance(err, DevicesUnavailable):
+                raise err  # no device left to retry on
+            if _is_oom(err) and len(meta) > 1:
+                stats.add(batch_splits=1)
+                ctx.count("secret.batch_splits")
+                logger.warning(
+                    "device OOM on a %d-row batch (%s); splitting and "
+                    "re-dispatching the halves", len(meta), err,
+                )
+                mid = (len(meta) + 1) // 2
+                return [
+                    (rebatch(batch[:mid], meta[:mid]), meta[:mid], retries),
+                    (rebatch(batch[mid:], meta[mid:]), meta[mid:], retries),
+                ]
+            if retries < self._batch_retries:
+                stats.add(batch_retries=1)
+                ctx.count("secret.batch_retries")
+                logger.warning(
+                    "device error on a %d-row batch (retry %d/%d): %s",
+                    len(meta), retries + 1, self._batch_retries, err,
+                )
+                return [(rebatch(batch, meta), meta, retries + 1)]
+            raise err
+
+        def dispatch_batch(batch, meta, retries) -> None:
+            work = [(batch, meta, retries)]
+            while work:
+                b, m, r = work.pop()
+                try:
+                    with ctx.span("secret.dispatch"):
+                        if dispatch_fn is not None:
+                            dev, didx = dispatch_fn(b)
+                        else:
+                            faults.check("device.dispatch", key="d0")
+                            dev, didx = match(b), None
+                except Exception as e:
+                    # dispatch-time failure (breaker already notified by
+                    # the round-robin wrapper); walk the ladder
+                    work.extend(recover(b, m, r, e))
+                    continue
+                pending.append((dev, m, b, didx, r))
 
         def fetch_oldest():
-            dev, meta = pending.popleft()
-            with ctx.span("secret.device_wait"):
-                out_q.put((np.asarray(dev), meta))
+            dev, meta, batch, didx, retries = pending.popleft()
+            try:
+                faults.check(
+                    "device.fetch", key=f"d{didx if didx is not None else 0}"
+                )
+                with ctx.span("secret.device_wait"):
+                    arr = np.asarray(dev)
+            except Exception as e:
+                if record is not None and didx is not None:
+                    record(didx, False)
+                for item in recover(batch, meta, retries, e):
+                    dispatch_batch(*item)
+                return
+            if record is not None and didx is not None:
+                record(didx, True)
+            out_q.put((arr, meta))
 
         with obs.activate(ctx):
             try:
@@ -359,22 +482,22 @@ class TpuSecretScanner:
                     if item is None:
                         break
                     batch, meta = item
-                    with ctx.span("secret.dispatch"):
-                        pending.append((self._match(batch), meta))
+                    dispatch_batch(batch, meta, 0)
                     if len(pending) >= self._pipeline_depth:
                         fetch_oldest()
                 while pending:
                     fetch_oldest()
-            except BaseException as e:  # device/tunnel failure: surface it
+            except BaseException as e:  # retry ladder exhausted: surface it
                 # the feeder sees the exception on its next drain and raises;
                 # empty the queue first so a feeder blocked on a full in_q
-                # wakes up (its batches are lost — the scan is failing anyway)
+                # wakes up (its batches are lost — either the scan is failing
+                # or the host fallback rescans every unresolved file anyway)
                 while True:
                     try:
                         in_q.get_nowait()
                     except queue.Empty:
                         break
-                out_q.put(e)
+                out_q.put(_DeviceFailed(e) if isinstance(e, Exception) else e)
                 return
             out_q.put(None)
 
@@ -605,55 +728,97 @@ class TpuSecretScanner:
                 pass
             device_thread.join()
 
+        def host_task(path: str, data: bytes) -> Secret:
+            # degraded-mode rung: the exact host engine IS the parity
+            # oracle, so fallback findings are byte-identical by definition
+            try:
+                with obs.activate(ctx), ctx.span("secret.host_fallback"):
+                    return self.exact.scan_bytes(path, data)
+            finally:
+                confirm_slots.release()
+
+        files_it = enumerate(files)
         try:
-            for fidx, (path, data) in enumerate(files):
-                total += 1
-                # path-level global allowlist: skip the whole file (ref:
-                # scanner.go:388-392) — no device work either
-                if self.exact.allow_path(path):
-                    results[fidx] = Secret(file_path=path)
-                elif not data:
-                    # empty file: nothing for the device to match — resolve
-                    # host-side immediately (host-lane rules still run there)
-                    st = _FileState(path=path, data=data, pending=0)
-                    confirm_slots.acquire()
-                    results[fidx] = pool.submit(confirm_task, st)
-                else:
-                    stats.add(bytes_in=len(data))
-                    if self._pack_small and len(data) <= pack_max:
-                        states[fidx] = _FileState(path=path, data=data, pending=1)
-                        add_small(fidx, data)
+            try:
+                for fidx, (path, data) in files_it:
+                    total += 1
+                    # path-level global allowlist: skip the whole file (ref:
+                    # scanner.go:388-392) — no device work either
+                    if self.exact.allow_path(path):
+                        results[fidx] = Secret(file_path=path)
+                    elif not data:
+                        # empty file: nothing for the device to match —
+                        # resolve host-side immediately (host-lane rules
+                        # still run there)
+                        st = _FileState(path=path, data=data, pending=0)
+                        confirm_slots.acquire()
+                        results[fidx] = pool.submit(confirm_task, st)
                     else:
-                        starts = chunk_spans(len(data), chunk_len, self.overlap)
-                        states[fidx] = _FileState(
-                            path=path, data=data, pending=len(starts)
-                        )
-                        arr = np.frombuffer(data, dtype=np.uint8)
-                        for s in starts:
-                            piece = arr[s : s + chunk_len]
-                            key = (
-                                hashlib.blake2b(
-                                    piece, digest_size=16, key=fp_key
-                                ).digest()
-                                if dedup
-                                else None
+                        stats.add(bytes_in=len(data))
+                        if self._pack_small and len(data) <= pack_max:
+                            states[fidx] = _FileState(
+                                path=path, data=data, pending=1
                             )
-                            feed_row(
-                                key,
-                                [(fidx, s, s + chunk_len)],
-                                [(0, piece)],
-                                len(piece),
-                                False,
+                            add_small(fidx, data)
+                        else:
+                            starts = chunk_spans(
+                                len(data), chunk_len, self.overlap
                             )
-                # emit in order as soon as the contiguous prefix is done;
-                # block on a confirmation only when it is next in line
-                while next_emit in results:
-                    r = results.pop(next_emit)
-                    yield r.result() if isinstance(r, Future) else r
-                    next_emit += 1
-            emit_pack()  # flush the partial pack row
-            flush()  # dispatch the final partial batch
-            drain()  # resolve whatever is still in flight
+                            states[fidx] = _FileState(
+                                path=path, data=data, pending=len(starts)
+                            )
+                            arr = np.frombuffer(data, dtype=np.uint8)
+                            for s in starts:
+                                piece = arr[s : s + chunk_len]
+                                key = (
+                                    hashlib.blake2b(
+                                        piece, digest_size=16, key=fp_key
+                                    ).digest()
+                                    if dedup
+                                    else None
+                                )
+                                feed_row(
+                                    key,
+                                    [(fidx, s, s + chunk_len)],
+                                    [(0, piece)],
+                                    len(piece),
+                                    False,
+                                )
+                    # emit in order as soon as the contiguous prefix is done;
+                    # block on a confirmation only when it is next in line
+                    while next_emit in results:
+                        r = results.pop(next_emit)
+                        yield r.result() if isinstance(r, Future) else r
+                        next_emit += 1
+                emit_pack()  # flush the partial pack row
+                flush()  # dispatch the final partial batch
+                drain()  # resolve whatever is still in flight
+            except _DeviceFailed as e:
+                # the device loop's retry ladder is exhausted (or every
+                # device is circuit-broken): last rung — finish the scan on
+                # the exact host path instead of failing it
+                if not self._host_fallback:
+                    raise e.cause from None
+                self._note_degraded(ctx, e.cause)
+                inflight.clear()
+                pack_pending.clear()
+                # every file with unresolved device work rescans host-side
+                # (partial device results for it are discarded); already-
+                # submitted confirms keep completing on the same pool
+                for fidx in sorted(states):
+                    st = states.pop(fidx)
+                    confirm_slots.acquire()
+                    results[fidx] = pool.submit(host_task, st.path, st.data)
+                # files not yet pulled from the input stream go straight to
+                # the host path, same backpressure bound
+                for fidx, (path, data) in files_it:
+                    total += 1
+                    confirm_slots.acquire()
+                    results[fidx] = pool.submit(host_task, path, data)
+                    while next_emit in results:
+                        r = results.pop(next_emit)
+                        yield r.result() if isinstance(r, Future) else r
+                        next_emit += 1
             while next_emit < total:
                 r = results.pop(next_emit)
                 yield r.result() if isinstance(r, Future) else r
@@ -677,6 +842,15 @@ class TpuSecretScanner:
     def scan_bytes(self, path: str, data: bytes) -> Secret:
         """Single-file convenience (still device-prefiltered)."""
         return next(iter(self.scan_files([(path, data)])))
+
+    def _note_degraded(self, ctx, err: BaseException) -> None:
+        logger.warning(
+            "device pipeline failed (%s); completing the scan on the exact "
+            "host confirm path — slower, findings identical", err,
+        )
+        self.stats.add(degraded=1)
+        ctx.count("secret.degraded")
+        obs.note_scan_degraded()
 
     # -- host confirmation --------------------------------------------------
 

@@ -901,9 +901,13 @@ class Report:
     artifact_type: str = ""
     metadata: dict[str, Any] = field(default_factory=dict)
     results: list[Result] = field(default_factory=list)
+    # the scan completed on a degraded path (host fallback after device
+    # failure, cache fallback, ...) — findings are still exact, but the
+    # run was slower than the healthy pipeline
+    degraded: bool = False
 
     def to_dict(self) -> dict[str, Any]:
-        return {
+        out = {
             "SchemaVersion": self.schema_version,
             "CreatedAt": self.created_at,
             "ArtifactName": self.artifact_name,
@@ -911,6 +915,9 @@ class Report:
             "Metadata": dict(self.metadata),
             "Results": [r.to_dict() for r in self.results],
         }
+        if self.degraded:
+            out["Degraded"] = True
+        return out
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "Report":
@@ -921,4 +928,5 @@ class Report:
             artifact_type=d.get("ArtifactType", ""),
             metadata=dict(d.get("Metadata", {}) or {}),
             results=[Result.from_dict(x) for x in d.get("Results", []) or []],
+            degraded=bool(d.get("Degraded", False)),
         )
