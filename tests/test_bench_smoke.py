@@ -87,3 +87,80 @@ def test_bench_smoke_fails_loudly_when_stage_missing(tmp_path, monkeypatch):
     )
     rc = bench.smoke()
     assert rc == 1
+
+
+def _bench_doc(value, extra=()):
+    return {
+        "metric": "secret_scan_e2e_throughput",
+        "value": value,
+        "unit": "MB/s",
+        "detail": {"extra_metrics": [
+            {"metric": m, "value": v} for m, v in extra
+        ]},
+    }
+
+
+@pytest.mark.slow
+def test_bench_check_regression_gate(tmp_path):
+    """bench.py --check-regression PREV --against CUR: exits 1 on a >15%
+    drop in the headline (or any comparable extra metric), 0 within the
+    band; errored side metrics are skipped, not compared."""
+    prev = tmp_path / "prev.json"
+    prev.write_text(json.dumps(_bench_doc(
+        10.0, [("cve_match_rate", 1000.0), ("license_classify_throughput", 20.0)]
+    )))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_bench_doc(
+        9.0, [("cve_match_rate", 900.0)]  # -10% / -10%: inside the band
+    )))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(_bench_doc(
+        10.0, [("cve_match_rate", 700.0)]  # -30% side metric
+    )))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def check(*argv):
+        return subprocess.run(
+            [sys.executable, "bench.py", "--check-regression", *argv],
+            capture_output=True, text=True, env=env, cwd="/root/repo",
+            timeout=120,
+        )
+
+    p = check(str(prev), "--against", str(ok))
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "bench_regression_check"
+    assert doc["regressions"] == []
+    # the license metric exists only in prev: skipped, not failed
+    assert "license_classify_throughput" not in [
+        r["metric"] for r in doc["rows"]
+    ]
+
+    p = check(str(prev), "--against", str(bad))
+    assert p.returncode == 1
+    assert "cve_match_rate regressed 30.0%" in p.stderr
+
+    # a looser threshold admits the same delta
+    p = check(str(prev), "--against", str(bad), "--threshold", "40")
+    assert p.returncode == 0, p.stderr
+
+
+@pytest.mark.slow
+def test_bench_check_regression_reads_wrapped_bench_json(tmp_path):
+    """Driver-wrapped BENCH_*.json ({"tail": "...{json}"}) parses too, so
+    the gate runs directly against the repo's recorded rounds."""
+    inner = _bench_doc(8.0)
+    wrapped = tmp_path / "BENCH_x.json"
+    wrapped.write_text(json.dumps(
+        {"n": 1, "rc": 0, "tail": "noise\n" + json.dumps(inner)}
+    ))
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(_bench_doc(8.1)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--check-regression", str(wrapped),
+         "--against", str(cur)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stderr

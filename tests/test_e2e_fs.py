@@ -158,10 +158,12 @@ def test_cli_trace_outputs(tree, tmp_path):
 
     trace_file = tmp_path / "trace.json"
     metrics_file = tmp_path / "metrics.json"
+    profile_file = tmp_path / "profile.json.gz"
     p = run_cli(
         "fs", "--scanners", "secret", "--backend", "auto", "--format", "json",
         "--trace", "--trace-out", str(trace_file),
         "--metrics-out", str(metrics_file),
+        "--profile-out", str(profile_file),
         "--cache-dir", str(tmp_path / "cache"), str(tree),
     )
     assert p.returncode == 0, p.stderr
@@ -191,6 +193,20 @@ def test_cli_trace_outputs(tree, tmp_path):
     assert mdoc["spans"]["secret.dispatch"]["count"] >= 1
     assert mdoc["counters"]["secret.bytes_uploaded"] > 0
     assert sum(mdoc["stall"]["secret"].values()) == 100
+    # per-rule cost profile: transparent gzip (.gz path), rules attributed,
+    # and the per-rule confirm time stays within the stage's stall total
+    import gzip
+
+    pdoc = json.loads(gzip.open(profile_file, "rt").read())
+    assert pdoc["profile"]["rules"]
+    assert pdoc["profile"]["rules"]["github-pat"]["findings"] >= 1
+    rule_ms = sum(
+        r["confirm_ms"] for r in pdoc["profile"]["rules"].values()
+    )
+    assert 0 < rule_ms <= pdoc["stage_total_ms"]["secret.confirm"] + 1e-6
+    assert pdoc["profile"]["buckets"]
+    # the --trace report prints the hottest-rules table
+    assert "hottest rules" in p.stderr and "github-pat" in p.stderr
 
 
 def test_trace_off_records_nothing(tree, tmp_path):

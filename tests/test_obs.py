@@ -310,6 +310,20 @@ class TestJsonLogging:
         log.logger("x").info("hello")
         assert "[trivy_tpu.x] hello" in buf.getvalue()
 
+    def test_json_lines_carry_active_trace_id(self):
+        """Log lines emitted inside a scan carry that scan's trace id —
+        the same id a client's traceparent propagated — so server logs
+        correlate with client traces."""
+        buf = io.StringIO()
+        log.init(stream=buf, fmt="json")
+        with obs.scan_context(name="corr", enabled=True) as ctx:
+            log.logger("rpc:server").info("mid-scan line")
+        log.logger("rpc:server").info("post-scan line")
+        lines = [json.loads(l) for l in buf.getvalue().strip().splitlines()]
+        assert lines[0]["trace_id"] == ctx.trace_id
+        # outside the scan the process-default context's id applies
+        assert lines[1]["trace_id"] != ctx.trace_id
+
 
 class TestHeartbeat:
     # a plain stdlib logger: the trivy_tpu root logger sets propagate=False
@@ -334,3 +348,16 @@ class TestHeartbeat:
             with obs.heartbeat(lg, "fast op", interval=30.0):
                 pass
         assert not [r for r in caplog.records if "fast op" in r.message]
+
+    def test_beats_include_trace_id(self, caplog):
+        """Server operators correlate a progress line with the client
+        trace that caused the work via the trace id on every beat."""
+        import logging
+
+        lg = logging.getLogger("obs-heartbeat-test3")
+        with obs.scan_context(name="hb", enabled=True) as ctx:
+            with caplog.at_level(logging.INFO, logger="obs-heartbeat-test3"):
+                with obs.heartbeat(lg, "traced op", interval=0.05):
+                    time.sleep(0.2)
+        msgs = [r.message for r in caplog.records if "traced op" in r.message]
+        assert msgs and f"[trace {ctx.trace_id}]" in msgs[0]

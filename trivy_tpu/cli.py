@@ -53,10 +53,16 @@ def global_flags() -> FlagGroup:
                       "stall-attribution verdict after the scan"),
             Flag("trace-out", default=None, config_name="trace.out",
                  help="write spans as Chrome trace-event JSON (Perfetto-"
-                      "loadable; implies span recording)"),
+                      "loadable; implies span recording; client mode merges "
+                      "the server's tracks; .gz path gzips)"),
             Flag("metrics-out", default=None, config_name="trace.metrics-out",
                  help="write aggregate span/counter metrics as JSON "
-                      "(implies span recording)"),
+                      "(implies span recording; .gz path gzips)"),
+            Flag("profile-out", default=None, config_name="trace.profile-out",
+                 help="write the per-rule / per-bucket cost profile (gate "
+                      "hits, confirm time, false-positive rate, dispatch-"
+                      "bucket timing) as JSON (implies span recording; "
+                      ".gz path gzips)"),
             Flag("log-format", default="plain", choices=["plain", "json"],
                  config_name="log.format",
                  help="log line format: plain, or one JSON object per line"),

@@ -147,7 +147,8 @@ def run(command: str, ns, opts) -> int:
     # disjoint tables instead of one global one. Span recording turns on
     # for --trace and whenever an export destination is given.
     trace_on = bool(
-        opts.get("trace") or opts.get("trace_out") or opts.get("metrics_out")
+        opts.get("trace") or opts.get("trace_out")
+        or opts.get("metrics_out") or opts.get("profile_out")
     )
     from trivy_tpu import faults
 
@@ -213,6 +214,11 @@ def run(command: str, ns, opts) -> int:
                 if opts.get("metrics_out"):
                     export.write_metrics_json(ctx, opts["metrics_out"])
                     logger.info("metrics written to %s", opts["metrics_out"])
+                if opts.get("profile_out"):
+                    export.write_profile_json(ctx, opts["profile_out"])
+                    logger.info(
+                        "scan profile written to %s", opts["profile_out"]
+                    )
 
 
 def _emit(report, ns, opts) -> int:

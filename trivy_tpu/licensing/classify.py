@@ -329,12 +329,17 @@ class LicenseClassifier:
         transfer and kernel execution overlapped, interleaving with any
         concurrent secret batches on the same device queue.
         """
+        import time
         from collections import deque
 
         from trivy_tpu import obs
         from trivy_tpu.ops import ngram_score as ng
 
         ctx = obs.current()
+        # per-corpus-shard cost profile: each gate/score dispatch records
+        # its row-bucket rung (and the mesh data-parallel shard count) so
+        # the license bucket ladder is tunable from data like the secret one
+        prof = ctx.profile() if ctx.enabled else None
         if not hasattr(self, "_gate_keys"):
             self._build_scoring()
         scorer = self._device_scorer()
@@ -357,8 +362,14 @@ class LicenseClassifier:
 
         def fetch_gate() -> None:
             dev, rows_p, tis = pending.popleft()
+            t0 = time.perf_counter()
             with ctx.span("license.device_wait"):
                 counts = np.asarray(dev)[: len(tis)]
+            if prof is not None:
+                prof.bucket_dispatch(
+                    f"license.gate:{rows_p.shape[0]}x{dp}",
+                    len(tis), time.perf_counter() - t0,
+                )
             sel = np.nonzero(counts > 0)[0]
             if len(sel):
                 T = rows_p.shape[1]
@@ -408,11 +419,17 @@ class LicenseClassifier:
         acc: dict[int, tuple[np.ndarray, np.ndarray]] = {}
 
         def fetch_score() -> None:
-            dev, tis = spending.popleft()
+            dev, tis, n_rows = spending.popleft()
             fw_d, pp_d = dev
+            t0 = time.perf_counter()
             with ctx.span("license.device_wait"):
                 fw_np = np.asarray(fw_d, dtype=np.float64)
                 pp_np = np.asarray(pp_d, dtype=np.float64)
+            if prof is not None:
+                prof.bucket_dispatch(
+                    f"license.score:{n_rows}x{dp}",
+                    len(tis), time.perf_counter() - t0,
+                )
             for i, ti in enumerate(tis.tolist()):
                 acc[ti] = (fw_np[i, :L], pp_np[i, :L])
 
@@ -427,7 +444,7 @@ class LicenseClassifier:
                 )
                 faults.check("device.dispatch", key="license")
                 with ctx.span("license.dispatch"):
-                    spending.append((scorer(part), part_t))
+                    spending.append((scorer(part), part_t, len(part)))
                 ctx.sample("license.queue_depth", len(spending))
                 if len(spending) >= DEVICE_PIPELINE_DEPTH:
                     fetch_score()

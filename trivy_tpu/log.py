@@ -26,7 +26,8 @@ def logger(prefix: str | None = None) -> logging.Logger:
 
 
 class _JSONFormatter(logging.Formatter):
-    """One JSON object per line: {"ts", "level", "subsystem", "msg"}."""
+    """One JSON object per line:
+    {"ts", "level", "subsystem", "msg", "trace_id"}."""
 
     def format(self, record: logging.LogRecord) -> str:
         subsystem = record.name
@@ -43,6 +44,16 @@ class _JSONFormatter(logging.Formatter):
             "subsystem": subsystem,
             "msg": record.getMessage(),
         }
+        # active scan trace id (the same id a client's traceparent carried,
+        # since server handlers join the incoming trace): lets collectors
+        # correlate server log lines with client traces. Lazy import — log
+        # must stay importable before/without the obs subsystem.
+        try:
+            from trivy_tpu import obs
+
+            doc["trace_id"] = obs.current().trace_id
+        except Exception:
+            pass
         if record.exc_info:
             doc["exc"] = self.formatException(record.exc_info)
         return json.dumps(doc)

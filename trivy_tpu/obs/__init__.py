@@ -48,14 +48,22 @@ __all__ = [
     "health_count",
     "heartbeat",
     "note_scan_degraded",
+    "parse_traceparent",
     "report",
     "sample",
     "scan_context",
     "span",
+    "traceparent",
 ]
 
-_span_ids = itertools.count(1)
-_trace_ids = itertools.count(1)
+# span ids are seeded with 40 random bits per process so spans from a
+# client and a remote server joined into one trace don't collide (a pid
+# seed would: containerized client and server are both pid 1), keeping
+# parent/child links in a merged export unambiguous; ids stay < 2**64 so
+# the traceparent %016x rendering never truncates
+_span_ids = itertools.count(
+    (int.from_bytes(os.urandom(5), "big") << 24) + 1
+)
 
 # raw span-event cap per context: aggregates (histograms, counters, stall
 # attribution) never drop, but the per-event list backing the Chrome trace
@@ -150,7 +158,8 @@ class _SpanCM:
     def __enter__(self) -> Span:
         ctx = self.ctx
         stack = ctx._stack()
-        parent = stack[-1].span_id if stack else None
+        # a root span of a joined trace parents to the remote caller's span
+        parent = stack[-1].span_id if stack else ctx.parent_span_id
         sp = Span(
             self.name,
             next(_span_ids),
@@ -180,11 +189,21 @@ class TraceContext:
     Span parenting is tracked per recording thread: nested ``span()`` calls
     on one thread chain parent ids; spans from worker threads that entered
     via :func:`activate` parent to whatever is open on *their* stack.
+
+    Cross-process: ``trace_id`` is a W3C-trace-context-shaped 32-hex id.
+    A server joining a client's trace passes the incoming ids —
+    ``trace_id`` plus ``parent_span_id`` (the client's RPC span), so its
+    root spans parent under the caller — and ships its span table back in
+    the scan response; the client folds it in with :meth:`ingest_remote`
+    so one export carries both sides of the wire.
     """
 
-    def __init__(self, name: str = "scan", enabled: bool = False):
+    def __init__(self, name: str = "scan", enabled: bool = False,
+                 trace_id: str | None = None,
+                 parent_span_id: int | None = None):
         self.name = name
-        self.trace_id = f"{os.getpid():x}-{next(_trace_ids):04x}"
+        self.trace_id = trace_id or os.urandom(16).hex()
+        self.parent_span_id = parent_span_id
         self.enabled = enabled
         self.created = time.perf_counter()
         self.created_wall = time.time()
@@ -198,6 +217,11 @@ class TraceContext:
         # scan-health events (degradations, skipped files): recorded even
         # with tracing off — they feed the report summary, not the trace
         self.health: dict[str, int] = {}
+        # serialized remote context docs (export.context_doc) joined into
+        # this trace — a server's half of a client-mode scan
+        self.remote: list[dict] = []
+        # per-rule / per-bucket cost profile, created lazily by profile()
+        self._profile = None
         self._local = threading.local()
 
     # -- recording ----------------------------------------------------------
@@ -233,12 +257,77 @@ class TraceContext:
             Span(
                 name,
                 next(_span_ids),
-                None,
+                self.parent_span_id,
                 time.perf_counter() - seconds,
                 seconds,
                 threading.get_ident(),
             )
         )
+
+    def current_span_id(self) -> int | None:
+        """The innermost open span on the calling thread (the parent a
+        child process should link under), falling back to this context's
+        own inherited parent."""
+        stack = getattr(self._local, "stack", None)
+        if stack:
+            return stack[-1].span_id
+        return self.parent_span_id
+
+    def profile(self):
+        """This scan's per-rule/per-bucket cost profile
+        (:class:`trivy_tpu.obs.profile.ScanProfile`), created lazily.
+        Pipelines guard recording on ``self.enabled`` themselves."""
+        from trivy_tpu.obs.profile import ScanProfile
+
+        with self._lock:
+            if self._profile is None:
+                self._profile = ScanProfile()
+            return self._profile
+
+    def ingest_remote(self, doc: dict) -> None:
+        """Join a remote scan's serialized context
+        (:func:`trivy_tpu.obs.export.context_doc`) into this trace: its
+        tracks ride the same Chrome-trace export, its stage totals feed the
+        unified stall verdict, and its profile merges into this scan's."""
+        if not isinstance(doc, dict):
+            return
+        with self._lock:
+            self.remote.append(doc)
+
+    def remote_stage_totals(self) -> dict[str, tuple[float, int]]:
+        """Stage totals of every joined remote context, with the pipeline
+        component prefixed ``server:`` so the stall verdict reports e.g.
+        ``server:driver`` and ``server:secret`` lines distinct from the
+        local pipelines."""
+        with self._lock:
+            docs = list(self.remote)
+        out: dict[str, tuple[float, int]] = {}
+        for doc in docs:
+            for name, s in (doc.get("spans") or {}).items():
+                key = f"server:{name}"
+                total, threads = out.get(key, (0.0, 0))
+                out[key] = (
+                    total + float(s.get("total", 0.0)),
+                    max(threads, int(s.get("threads", 1))),
+                )
+        return out
+
+    def merged_profile_dict(self) -> dict:
+        """Local profile plus every joined remote profile as one dict —
+        what ``--profile-out`` writes and the report table renders."""
+        from trivy_tpu.obs.profile import ScanProfile
+
+        with self._lock:
+            local = self._profile
+            docs = list(self.remote)
+        merged = ScanProfile()
+        if local is not None:
+            merged.merge_dict(local.to_dict())
+        for doc in docs:
+            p = doc.get("profile")
+            if p:
+                merged.merge_dict(p)
+        return merged.to_dict()
 
     def count(self, name: str, n: int = 1) -> None:
         """Accumulate an integer counter (byte/item tallies)."""
@@ -285,6 +374,8 @@ class TraceContext:
             self.counters.clear()
             self.samples.clear()
             self.health.clear()
+            self.remote.clear()
+            self._profile = None
 
     # -- aggregation --------------------------------------------------------
 
@@ -341,7 +432,19 @@ class TraceContext:
             samples = {
                 k: (v[0], v[1], v[2]) for k, v in sorted(self.samples.items())
             }
-        if not stats and not counters and not samples:
+            remote_docs = list(self.remote)
+        # joined remote (server-side) stages render in the same table,
+        # prefixed "server:", so one report covers both sides of the wire
+        for doc in remote_docs:
+            for name, s in sorted((doc.get("spans") or {}).items()):
+                agg = wire_span_stats(s)
+                if agg["count"]:
+                    stats[f"server:{name}"] = agg
+            for name, value in sorted((doc.get("counters") or {}).items()):
+                counters.append((f"server:{name}", value))
+        prof_doc = self.merged_profile_dict()
+        has_profile = bool(prof_doc.get("rules") or prof_doc.get("buckets"))
+        if not stats and not counters and not samples and not has_profile:
             return
         rows = sorted(stats.items(), key=lambda kv: -kv[1]["total"])
         out.write("\n-- trace " + "-" * 71 + "\n")
@@ -374,6 +477,16 @@ class TraceContext:
             out.write("-- stall attribution " + "-" * 59 + "\n")
             for line in lines:
                 out.write(line + "\n")
+        from trivy_tpu.obs import profile as _profile
+
+        prof_lines = _profile.table_lines(prof_doc)
+        if prof_lines:
+            out.write(
+                f"-- hottest rules (top {_profile.TOP_K} by confirm cost) "
+                + "-" * 33 + "\n"
+            )
+            for line in prof_lines:
+                out.write(line + "\n")
         if self.dropped_events:
             out.write(
                 f"(note: {self.dropped_events} raw span events dropped past "
@@ -389,6 +502,24 @@ def percentile(values: list[float], p: float) -> float:
     s = sorted(values)
     idx = int(round((p / 100.0) * (len(s) - 1)))
     return s[max(0, min(idx, len(s) - 1))]
+
+
+def wire_span_stats(s: dict) -> dict:
+    """Aggregate one serialized stage entry (a ``context_doc`` ``spans``
+    value off the wire) into count/total/mean/p50/p95/max — the single
+    place the remote span schema is parsed, shared by :meth:`report` and
+    the metrics ``remote`` block."""
+    count = int(s.get("count", 0))
+    total = float(s.get("total", 0.0))
+    values = list(s.get("values") or [])
+    return {
+        "count": count,
+        "total": total,
+        "mean": total / count if count else 0.0,
+        "p50": percentile(values, 50),
+        "p95": percentile(values, 95),
+        "max": float(s.get("max", 0.0)),
+    }
 
 
 # -- module-level surface ---------------------------------------------------
@@ -409,11 +540,18 @@ def current() -> TraceContext:
 
 
 @contextmanager
-def scan_context(name: str = "scan", enabled: bool | None = None):
+def scan_context(name: str = "scan", enabled: bool | None = None,
+                 trace_id: str | None = None,
+                 parent_span_id: int | None = None):
     """Enter a fresh per-scan context. ``enabled=None`` inherits the process
-    default's enabled bit (set by :func:`enable` / the ``--trace`` flag)."""
+    default's enabled bit (set by :func:`enable` / the ``--trace`` flag).
+    ``trace_id``/``parent_span_id`` join an existing distributed trace (a
+    server handling a client's ``traceparent``) instead of minting one."""
     ctx = TraceContext(
-        name=name, enabled=_default_ctx.enabled if enabled is None else enabled
+        name=name,
+        enabled=_default_ctx.enabled if enabled is None else enabled,
+        trace_id=trace_id,
+        parent_span_id=parent_span_id,
     )
     token = _current.set(ctx)
     try:
@@ -488,6 +626,39 @@ def report(out=None) -> None:
     current().report(out)
 
 
+_HEX = set("0123456789abcdef")
+
+
+def traceparent(span: Span | None = None) -> str:
+    """W3C-style ``traceparent`` header for the active context:
+    ``00-<32-hex trace id>-<16-hex parent span id>-01``. The parent id is
+    ``span``'s (when the caller holds one open) or the calling thread's
+    innermost open span; an all-zero parent means "join the trace id, no
+    parent link" (tracing off on the client side)."""
+    ctx = current()
+    sid = span.span_id if span is not None else ctx.current_span_id()
+    return f"00-{ctx.trace_id}-{(sid or 0):016x}-01"
+
+
+def parse_traceparent(value: str | None) -> tuple[str, int | None] | None:
+    """``(trace_id, parent_span_id)`` from a traceparent header, or None
+    when absent/malformed. A zero parent id maps to None (no parent)."""
+    if not value:
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    _ver, tid, pid, _flags = parts
+    if len(tid) != 32 or len(pid) != 16:
+        return None
+    if set(tid) - _HEX or set(pid) - _HEX:
+        return None
+    if tid == "0" * 32:
+        return None
+    parent = int(pid, 16)
+    return tid, (parent or None)
+
+
 class heartbeat:
     """Progress logging for long-running operations: while the block runs,
     log one line every ``interval`` seconds (elapsed time plus an optional
@@ -503,24 +674,31 @@ class heartbeat:
         self.progress = progress
         self._stop = threading.Event()
         self._t0 = 0.0
+        self._ctx: TraceContext | None = None
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
-            extra = ""
-            if self.progress is not None:
-                try:
-                    extra = f" ({self.progress()})"
-                except Exception:
-                    pass
-            self.logger.info(
-                "%s in progress: %.0fs elapsed%s",
-                self.what,
-                time.perf_counter() - self._t0,
-                extra,
-            )
+        # the beat thread re-enters the spawning scan's context so the log
+        # line (and the json formatter's trace_id field) correlates with
+        # the client trace that caused this work
+        with activate(self._ctx or _default_ctx):
+            while not self._stop.wait(self.interval):
+                extra = ""
+                if self.progress is not None:
+                    try:
+                        extra = f" ({self.progress()})"
+                    except Exception:
+                        pass
+                self.logger.info(
+                    "%s in progress: %.0fs elapsed%s [trace %s]",
+                    self.what,
+                    time.perf_counter() - self._t0,
+                    extra,
+                    self._ctx.trace_id if self._ctx else "-",
+                )
 
     def __enter__(self) -> "heartbeat":
         self._t0 = time.perf_counter()
+        self._ctx = current()
         threading.Thread(target=self._loop, daemon=True).start()
         return self
 

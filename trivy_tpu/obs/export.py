@@ -1,24 +1,121 @@
-"""Trace/metrics file export.
+"""Trace/metrics/profile file export.
 
 - :func:`write_chrome_trace` — Chrome trace-event JSON (the format Perfetto
   and ``chrome://tracing`` load): one ``X`` complete event per span, one
   named track per pipeline stage (and per device stream — mesh dispatch
   spans are named per device), thread_name metadata events labeling tracks.
+  Remote contexts joined via ``TraceContext.ingest_remote`` (a server's
+  half of a client-mode scan) render as additional processes (pid 2, 3,
+  ...) in the same timeline, timestamp-aligned via wall clocks, so one
+  file shows client tracks + server tracks + device streams under one
+  trace id.
 - :func:`write_metrics_json` — the aggregate view: per-stage histograms
-  (count/total/mean/p50/p95/max), counters, sample stats, and the stall-
-  attribution verdict. ``bench.py`` embeds this dict into BENCH reps.
+  (count/total/mean/p50/p95/max), counters, sample stats, the stall-
+  attribution verdict, and the per-rule/per-bucket profile. ``bench.py``
+  embeds this dict into BENCH reps.
+- :func:`write_profile_json` — just the cost-attribution view: the merged
+  (client+server) per-rule/per-bucket profile plus the stall verdict and
+  stage totals it must stay consistent with.
+- :func:`context_doc` — the wire form of a context (bounded events +
+  aggregates + profile) a scan server returns in its response.
+
+Every path-based writer gzips transparently when the destination ends in
+``.gz`` — merged cross-process traces get large.
 """
 
 from __future__ import annotations
 
 import json
+import os
 
-from trivy_tpu.obs import TraceContext
+from trivy_tpu.obs import TraceContext, percentile, wire_span_stats
 from trivy_tpu.obs import stall as _stall
+
+# bounds for the wire form of a context (a scan response rides HTTP):
+# events beyond the cap are dropped from the remote timeline — aggregates
+# and the profile never drop — and per-stage reservoirs are truncated
+WIRE_MAX_EVENTS = 4096
+WIRE_RESERVOIR = 256
+
+
+def _dump(doc: dict, dest, indent: int | None = None) -> None:
+    """Write JSON to a file object or path; paths ending in .gz gzip."""
+    if hasattr(dest, "write"):
+        json.dump(doc, dest, indent=indent)
+        return
+    if str(dest).endswith(".gz"):
+        import gzip
+
+        with gzip.open(dest, "wt") as f:
+            json.dump(doc, f, indent=indent)
+    else:
+        with open(dest, "w") as f:
+            json.dump(doc, f, indent=indent)
+
+
+def _wire_values(values: list[float]) -> list[float]:
+    """Bound a stage's duration reservoir for the wire by a uniform strided
+    pick — a plain ``[:n]`` prefix would bias the receiver's percentiles
+    toward the earliest (cold-cache, warm-up) spans of the scan."""
+    n = len(values)
+    if n <= WIRE_RESERVOIR:
+        return values
+    step = n / WIRE_RESERVOIR
+    return [values[int(i * step)] for i in range(WIRE_RESERVOIR)]
+
+
+def context_doc(ctx: TraceContext, max_events: int = WIRE_MAX_EVENTS) -> dict:
+    """Serialize a context for the wire: bounded raw events (start times
+    rebased to the context's creation so the receiver can align them via
+    ``created_wall``), exact per-stage aggregates with a bounded percentile
+    reservoir, counters, samples, and the scan profile."""
+    with ctx._lock:
+        events = [
+            {
+                "name": sp.name,
+                "span_id": sp.span_id,
+                "parent_id": sp.parent_id,
+                "start": round(sp.start - ctx.created, 6),
+                "duration": round(sp.duration, 6),
+                "thread": sp.thread,
+            }
+            for sp in ctx.events[:max_events]
+        ]
+        dropped = ctx.dropped_events + max(0, len(ctx.events) - max_events)
+        spans = {
+            name: {
+                "count": a.count,
+                "total": round(a.total, 6),
+                "max": round(a.vmax, 6),
+                "threads": len(a.threads),
+                "values": [round(v, 6) for v in _wire_values(a.values)],
+            }
+            for name, a in ctx.durations.items()
+            if a.count
+        }
+        counters = dict(ctx.counters)
+        samples = {k: [v[0], v[1], v[2]] for k, v in ctx.samples.items()}
+        prof = ctx._profile
+    doc = {
+        "trace_id": ctx.trace_id,
+        "name": ctx.name,
+        "pid": os.getpid(),
+        "created_wall": ctx.created_wall,
+        "root_parent_id": ctx.parent_span_id,
+        "events": events,
+        "spans": spans,
+        "counters": counters,
+        "samples": samples,
+        "dropped_events": dropped,
+    }
+    if prof is not None:
+        doc["profile"] = prof.to_dict()
+    return doc
 
 
 def chrome_trace_events(ctx: TraceContext) -> list[dict]:
-    """Flatten a context into trace-event dicts (sorted by start time)."""
+    """Flatten a context — plus any joined remote contexts — into
+    trace-event dicts (sorted by start time per process)."""
     events: list[dict] = [
         {
             "name": "process_name",
@@ -28,69 +125,112 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
             "args": {"name": f"trivy-tpu {ctx.name} [{ctx.trace_id}]"},
         }
     ]
-    # track per (stage, thread): a stage whose spans run concurrently in N
-    # threads (the confirm pool) gets N tracks ("stage", "stage #2", ...)
-    # instead of one track with overlapping slices Perfetto would mangle
-    tids: dict[tuple[str, int], int] = {}
-    per_stage_threads: dict[str, int] = {}
+    # track per (pid, stage, thread): a stage whose spans run concurrently
+    # in N threads (the confirm pool) gets N tracks ("stage", "stage #2",
+    # ...) instead of one track with overlapping slices Perfetto would
+    # mangle; tids are globally unique across processes
+    tids: dict[tuple[int, str, int], int] = {}
+    per_stage_threads: dict[tuple[int, str], int] = {}
 
-    def tid_for(name: str, thread: int) -> int:
-        key = (name, thread)
+    def tid_for(pid: int, name: str, thread: int) -> int:
+        key = (pid, name, thread)
         t = tids.get(key)
         if t is None:
             t = tids[key] = len(tids) + 1
-            n = per_stage_threads[name] = per_stage_threads.get(name, 0) + 1
+            skey = (pid, name)
+            n = per_stage_threads[skey] = per_stage_threads.get(skey, 0) + 1
             label = name if n == 1 else f"{name} #{n}"
             events.append(
                 {
                     "name": "thread_name",
                     "ph": "M",
-                    "pid": 1,
+                    "pid": pid,
                     "tid": t,
                     "args": {"name": label},
                 }
             )
         return t
 
-    with ctx._lock:
-        spans = list(ctx.events)
-    for sp in sorted(spans, key=lambda s: s.start):
-        args = {"trace_id": ctx.trace_id, "span_id": sp.span_id}
-        if sp.parent_id is not None:
-            args["parent_span_id"] = sp.parent_id
+    def emit(pid: int, trace_id: str, name: str, thread: int, span_id,
+             parent_id, ts_us: float, dur_s: float) -> None:
+        args = {"trace_id": trace_id, "span_id": span_id}
+        if parent_id is not None:
+            args["parent_span_id"] = parent_id
         events.append(
             {
-                "name": sp.name,
-                "cat": sp.name.split(".", 1)[0],
+                "name": name,
+                "cat": name.split(".", 1)[0],
                 "ph": "X",
-                "pid": 1,
-                "tid": tid_for(sp.name, sp.thread),
+                "pid": pid,
+                "tid": tid_for(pid, name, thread),
                 # clamp: add()-style backdated spans can start a hair
                 # before the context's own creation timestamp
-                "ts": max(0.0, round((sp.start - ctx.created) * 1e6, 3)),
-                "dur": round(sp.duration * 1e6, 3),
+                "ts": max(0.0, round(ts_us, 3)),
+                "dur": round(dur_s * 1e6, 3),
                 "args": args,
             }
         )
+
+    with ctx._lock:
+        spans = list(ctx.events)
+        remote_docs = list(ctx.remote)
+    for sp in sorted(spans, key=lambda s: s.start):
+        emit(
+            1, ctx.trace_id, sp.name, sp.thread, sp.span_id, sp.parent_id,
+            (sp.start - ctx.created) * 1e6, sp.duration,
+        )
+    for i, doc in enumerate(remote_docs):
+        pid = 2 + i
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": 0,
+                "args": {
+                    "name": f"trivy-tpu {doc.get('name', 'remote')} "
+                            f"[{doc.get('trace_id', '')}] (remote)"
+                },
+            }
+        )
+        # perf_counter clocks don't compare across processes; align the
+        # remote timeline by the wall-clock delta between context creations
+        base_us = (
+            doc.get("created_wall", ctx.created_wall) - ctx.created_wall
+        ) * 1e6
+        remote_spans = sorted(doc.get("events", []), key=lambda s: s["start"])
+        if remote_spans:
+            # wall clocks skew across hosts: a server clock running behind
+            # would push the aligned track negative, and the per-event
+            # clamp would collapse its early spans onto t=0 — shift the
+            # whole track instead so relative timing survives
+            first_us = base_us + remote_spans[0]["start"] * 1e6
+            if first_us < 0:
+                base_us -= first_us
+        for sp in remote_spans:
+            emit(
+                pid, doc.get("trace_id", ""), sp["name"],
+                sp.get("thread", 0), sp.get("span_id"), sp.get("parent_id"),
+                base_us + sp["start"] * 1e6, sp.get("duration", 0.0),
+            )
     return events
 
 
 def write_chrome_trace(ctx: TraceContext, dest) -> None:
-    """Write Perfetto-loadable trace-event JSON to a path or file object."""
+    """Write Perfetto-loadable trace-event JSON to a path or file object
+    (transparent gzip when the path ends in .gz)."""
+    with ctx._lock:
+        remote_dropped = sum(d.get("dropped_events", 0) for d in ctx.remote)
     doc = {
         "traceEvents": chrome_trace_events(ctx),
         "displayTimeUnit": "ms",
         "otherData": {
             "trace_id": ctx.trace_id,
             "name": ctx.name,
-            "dropped_events": ctx.dropped_events,
+            "dropped_events": ctx.dropped_events + remote_dropped,
         },
     }
-    if hasattr(dest, "write"):
-        json.dump(doc, dest)
-    else:
-        with open(dest, "w") as f:
-            json.dump(doc, f)
+    _dump(doc, dest)
 
 
 def metrics_dict(ctx: TraceContext) -> dict:
@@ -100,7 +240,8 @@ def metrics_dict(ctx: TraceContext) -> dict:
         samples = {
             k: (v[0], v[1], v[2]) for k, v in sorted(ctx.samples.items())
         }
-    return {
+        remote_docs = list(ctx.remote)
+    doc = {
         "trace_id": ctx.trace_id,
         "name": ctx.name,
         "spans": {
@@ -118,13 +259,47 @@ def metrics_dict(ctx: TraceContext) -> dict:
             if count
         },
         "stall": _stall.attribution(ctx),
+        "profile": ctx.merged_profile_dict(),
         "dropped_events": ctx.dropped_events,
     }
+    if remote_docs:
+        doc["remote"] = [
+            {
+                "trace_id": d.get("trace_id"),
+                "name": d.get("name"),
+                "spans": {
+                    name: {
+                        k: round(v, 6) if isinstance(v, float) else v
+                        for k, v in wire_span_stats(s).items()
+                    }
+                    for name, s in sorted((d.get("spans") or {}).items())
+                },
+                "counters": dict(sorted((d.get("counters") or {}).items())),
+            }
+            for d in remote_docs
+        ]
+    return doc
 
 
 def write_metrics_json(ctx: TraceContext, dest) -> None:
-    if hasattr(dest, "write"):
-        json.dump(metrics_dict(ctx), dest, indent=2)
-    else:
-        with open(dest, "w") as f:
-            json.dump(metrics_dict(ctx), f, indent=2)
+    _dump(metrics_dict(ctx), dest, indent=2)
+
+
+def profile_dict(ctx: TraceContext) -> dict:
+    """The cost-attribution view: merged client+server profile, the stall
+    verdict it refines, and local stage totals (ms) so consumers can check
+    the per-rule times sum consistently with the pipeline stages."""
+    return {
+        "trace_id": ctx.trace_id,
+        "name": ctx.name,
+        "profile": ctx.merged_profile_dict(),
+        "stall": _stall.attribution(ctx),
+        "stage_total_ms": {
+            name: round(s["total"] * 1e3, 3)
+            for name, s in ctx.stage_stats().items()
+        },
+    }
+
+
+def write_profile_json(ctx: TraceContext, dest) -> None:
+    _dump(profile_dict(ctx), dest, indent=2)

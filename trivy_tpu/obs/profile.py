@@ -1,0 +1,197 @@
+"""Per-rule / per-bucket cost attribution for one scan ("scan profile").
+
+Stall attribution (:mod:`trivy_tpu.obs.stall`) says *which stage* of a
+pipeline is slow; this module says *which rule* and *which dispatch bucket*
+— the difference between "confirm-bound 40%" and "confirm-bound 40%,
+of which `aws-secret-access-key` burns 31% confirming device hits that the
+exact host engine rejects". The batched-NFA design makes this essential:
+one pathological rule (a hot keyword gate with a high host-confirm
+false-positive rate) can dominate device time and confirm stalls while
+staying invisible in per-stage totals.
+
+Recorded per rule id:
+
+- ``gate_hits`` — device prefilter hits ((row, rule) pairs the kernel
+  flagged, including rows served from the dedup hit cache: a cached hit is
+  still a logical device hit that will cost a confirm)
+- ``confirms`` / ``confirm_s`` — exact host confirmations run for the rule
+  and their wall time (on the CPU backend and the degraded host-fallback
+  path this is the full rule evaluation, so a degraded scan still produces
+  a complete profile)
+- ``findings`` — locations that survived confirmation
+- ``wasted_confirms`` / ``wasted_confirm_s`` — confirms that produced zero
+  findings: pure false-positive cost. ``fp_rate`` = wasted / confirms is
+  the gate false-positive rate the bucket-ladder and keyword-gate tuning
+  rounds need.
+
+Recorded per dispatch bucket (the batch-shape ladder — ``"1024"``,
+``"512"``, ... for the secret pipeline; ``"license.gate:64"`` /
+``"license.score:64"`` for the license corpus shards): dispatches, rows,
+and blocking device-wait seconds, so the ladder is tunable from data
+instead of folklore.
+
+A :class:`ScanProfile` lives on a :class:`trivy_tpu.obs.TraceContext`
+(created lazily via ``ctx.profile()``); serialized profiles (the ``Trace``
+block of a remote scan response, or a saved ``--profile-out`` file) fold
+into another profile with :meth:`ScanProfile.merge_dict`, which is how the
+client merges its own pipeline profile with the server's.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+# per-scan bound on rule label cardinality exported to Prometheus and the
+# report table; the full profile still lands in --profile-out
+
+
+def _topk_from_env() -> int:
+    try:
+        return max(1, int(os.environ.get("TRIVY_TPU_PROFILE_TOPK", "10")))
+    except ValueError:
+        return 10
+
+
+TOP_K = _topk_from_env()
+
+# internal per-rule slots: gate_hits, confirms, confirm_s, findings,
+# wasted_confirms, wasted_confirm_s
+_R = 6
+
+
+class ScanProfile:
+    """Thread-safe per-rule and per-bucket accumulators for one scan."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: dict[str, list] = {}
+        self._buckets: dict[str, list] = {}  # key -> [dispatches, rows, wait_s]
+
+    def __bool__(self) -> bool:
+        with self._lock:
+            return bool(self._rules or self._buckets)
+
+    # -- recording ----------------------------------------------------------
+
+    def gate_hit(self, rule_id: str, n: int = 1) -> None:
+        """The device prefilter flagged ``rule_id`` on ``n`` rows."""
+        with self._lock:
+            r = self._rules.get(rule_id)
+            if r is None:
+                r = self._rules[rule_id] = [0, 0, 0.0, 0, 0, 0.0]
+            r[0] += n
+
+    def confirm(self, rule_id: str, seconds: float, findings: int) -> None:
+        """One exact host evaluation of ``rule_id`` took ``seconds`` and
+        yielded ``findings`` surviving locations."""
+        with self._lock:
+            r = self._rules.get(rule_id)
+            if r is None:
+                r = self._rules[rule_id] = [0, 0, 0.0, 0, 0, 0.0]
+            r[1] += 1
+            r[2] += seconds
+            r[3] += findings
+            if findings == 0:
+                r[4] += 1
+                r[5] += seconds
+
+    def bucket_dispatch(self, bucket, rows: int, wait_s: float) -> None:
+        """One device dispatch of ``rows`` live rows in shape-bucket
+        ``bucket`` spent ``wait_s`` in the blocking result fetch."""
+        key = str(bucket)
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = [0, 0, 0.0]
+            b[0] += 1
+            b[1] += rows
+            b[2] += wait_s
+
+    def merge_dict(self, doc: dict) -> None:
+        """Fold a serialized profile (:meth:`to_dict` output) into this one
+        — used to merge a remote scan's profile into the client's."""
+        for rid, f in (doc.get("rules") or {}).items():
+            with self._lock:
+                r = self._rules.get(rid)
+                if r is None:
+                    r = self._rules[rid] = [0, 0, 0.0, 0, 0, 0.0]
+                r[0] += int(f.get("gate_hits", 0))
+                r[1] += int(f.get("confirms", 0))
+                r[2] += float(f.get("confirm_ms", 0.0)) / 1e3
+                r[3] += int(f.get("findings", 0))
+                r[4] += int(f.get("wasted_confirms", 0))
+                r[5] += float(f.get("wasted_confirm_ms", 0.0)) / 1e3
+        for key, bf in (doc.get("buckets") or {}).items():
+            with self._lock:
+                b = self._buckets.get(key)
+                if b is None:
+                    b = self._buckets[key] = [0, 0, 0.0]
+                b[0] += int(bf.get("dispatches", 0))
+                b[1] += int(bf.get("rows", 0))
+                b[2] += float(bf.get("device_wait_ms", 0.0)) / 1e3
+
+    # -- serialization ------------------------------------------------------
+
+    def to_dict(self, top_k: int | None = None) -> dict:
+        """JSON-serializable profile; rules ordered hottest-first (confirm
+        time, then gate hits). ``top_k`` bounds the rule list for embedded
+        copies (bench reps); None keeps every rule."""
+        with self._lock:
+            rules = {k: list(v) for k, v in self._rules.items()}
+            buckets = {k: list(v) for k, v in self._buckets.items()}
+        items = sorted(rules.items(), key=lambda kv: (-kv[1][2], -kv[1][0], kv[0]))
+        if top_k is not None:
+            items = items[:top_k]
+        return {
+            "rules": {
+                rid: {
+                    "gate_hits": g,
+                    "confirms": c,
+                    "confirm_ms": round(cs * 1e3, 3),
+                    "findings": f,
+                    "wasted_confirms": wc,
+                    "wasted_confirm_ms": round(wcs * 1e3, 3),
+                    "fp_rate": round(wc / c, 4) if c else 0.0,
+                }
+                for rid, (g, c, cs, f, wc, wcs) in items
+            },
+            "buckets": {
+                k: {
+                    "dispatches": d,
+                    "rows": rows,
+                    "device_wait_ms": round(s * 1e3, 3),
+                }
+                for k, (d, rows, s) in sorted(buckets.items())
+            },
+        }
+
+
+def top_rules(doc: dict, k: int | None = None) -> list[tuple[str, dict]]:
+    """Hottest rules of a serialized profile: by confirm time, then gate
+    hits. ``k`` defaults to the TOP_K export bound."""
+    items = sorted(
+        (doc.get("rules") or {}).items(),
+        key=lambda kv: (-kv[1].get("confirm_ms", 0.0), -kv[1].get("gate_hits", 0), kv[0]),
+    )
+    return items[: TOP_K if k is None else k]
+
+
+def table_lines(doc: dict, k: int | None = None) -> list[str]:
+    """Formatted top-K "hottest rules" table for the --trace report."""
+    rows = top_rules(doc, k)
+    if not rows:
+        return []
+    lines = [
+        f"{'rule':<34}{'gate_hits':>10}{'confirms':>9}{'confirm':>10}"
+        f"{'fp%':>7}{'wasted':>10}{'found':>6}"
+    ]
+    for rid, f in rows:
+        lines.append(
+            f"{rid:<34}{f.get('gate_hits', 0):>10}{f.get('confirms', 0):>9}"
+            f"{f.get('confirm_ms', 0.0):>8.1f}ms"
+            f"{100.0 * f.get('fp_rate', 0.0):>6.1f}%"
+            f"{f.get('wasted_confirm_ms', 0.0):>8.1f}ms"
+            f"{f.get('findings', 0):>6}"
+        )
+    return lines

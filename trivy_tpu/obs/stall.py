@@ -61,7 +61,10 @@ def _largest_remainder_pcts(totals: dict[str, float]) -> dict[str, int]:
 
 def attribution(ctx: TraceContext) -> dict[str, dict[str, int]]:
     """pipeline -> {bucket: integer pct}; percentages sum to 100 per
-    pipeline. Pipelines with no bucketed span time are omitted.
+    pipeline. Pipelines with no bucketed span time are omitted. Remote
+    contexts joined via ``ctx.ingest_remote`` contribute their own
+    pipelines under a ``server:`` prefix (``server:secret``, ...), so a
+    client-mode scan's verdict covers both sides of the wire.
 
     Stage totals are normalized by the number of distinct threads that
     recorded the stage: confirm-pool spans run in N concurrent workers, so
@@ -71,8 +74,10 @@ def attribution(ctx: TraceContext) -> dict[str, dict[str, int]]:
     bottleneck even when the pipeline is device-limited. Dividing by the
     recording-thread count yields each stage's per-worker wall-time share,
     commensurable across serial and pooled stages."""
+    items = list(ctx.stage_totals().items())
+    items.extend(ctx.remote_stage_totals().items())
     totals: dict[str, dict[str, float]] = {}
-    for name, (total, n_threads) in ctx.stage_totals().items():
+    for name, (total, n_threads) in items:
         if "." not in name:
             continue
         pipeline, stage = name.split(".", 1)

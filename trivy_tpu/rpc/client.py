@@ -20,7 +20,7 @@ import time
 import urllib.error
 import urllib.request
 
-from trivy_tpu import faults, log, rpc
+from trivy_tpu import faults, log, obs, rpc
 from trivy_tpu.scanner import ScanOptions
 from trivy_tpu.types import OS, Result
 
@@ -56,6 +56,11 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
         if body is not raw:
             req.add_header("Content-Encoding", "gzip")
         req.add_header("Accept-Encoding", "gzip")
+        # distributed tracing: every request carries the active trace id
+        # (and the caller's open span as parent) so the server joins the
+        # client's trace instead of minting a fresh one, and server logs
+        # correlate with client traces even when tracing is off
+        req.add_header("traceparent", obs.traceparent())
         if token:
             req.add_header(token_header, token)
         retry_after: float | None = None
@@ -174,24 +179,33 @@ class RemoteDriver:
 
     def scan(self, target: str, artifact_id: str, blob_ids: list[str],
              options: ScanOptions):
-        resp = _post(
-            self.base,
-            rpc.SCANNER_SCAN,
-            {
-                "Target": target,
-                "ArtifactID": artifact_id,
-                "BlobIDs": blob_ids,
-                "Options": {
-                    "Scanners": list(options.scanners),
-                    "ListAllPkgs": options.list_all_pkgs,
+        ctx = obs.current()
+        # the rpc.scan span is the parent the server's trace joins under
+        # (its id rides the traceparent header _post attaches); WantTrace
+        # asks the server to return its span table, which merges into this
+        # context so --trace-out/report cover both sides of the wire
+        with ctx.span("rpc.scan"):
+            resp = _post(
+                self.base,
+                rpc.SCANNER_SCAN,
+                {
+                    "Target": target,
+                    "ArtifactID": artifact_id,
+                    "BlobIDs": blob_ids,
+                    "Options": {
+                        "Scanners": list(options.scanners),
+                        "ListAllPkgs": options.list_all_pkgs,
+                    },
+                    "WantTrace": bool(ctx.enabled),
                 },
-            },
-            self.token,
-            self.token_header,
-            self.timeout,
-            self.retries,
-            self.deadline,
-        )
+                self.token,
+                self.token_header,
+                self.timeout,
+                self.retries,
+                self.deadline,
+            )
+        if ctx.enabled and resp.get("Trace"):
+            ctx.ingest_remote(resp["Trace"])
         results = [Result.from_dict(r) for r in resp.get("Results", [])]
         os_info = OS.from_dict(resp["OS"]) if resp.get("OS") else None
         return results, os_info

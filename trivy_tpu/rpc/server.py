@@ -131,18 +131,47 @@ class ServerMetrics:
             "trivy_tpu_secret_dedup_bytes_total",
             "Corpus bytes resolved from the secret chunk-dedup hit cache",
         )
+        # per-rule cost attribution, bounded to the TOP_K hottest rules of
+        # each scan (label cardinality stays bounded; the full profile is
+        # in the scan's Trace response / --profile-out)
+        self.rule_gate_hits = r.counter(
+            "trivy_tpu_rule_gate_hits_total",
+            "Device prefilter hits by secret rule (top-K per scan)",
+            labelnames=("rule",),
+        )
+        self.rule_confirm_seconds = r.counter(
+            "trivy_tpu_rule_confirm_seconds_total",
+            "Exact host confirmation wall time by rule (top-K per scan)",
+            labelnames=("rule",),
+        )
+        self.rule_wasted_confirm_seconds = r.counter(
+            "trivy_tpu_rule_wasted_confirm_seconds_total",
+            "Confirmation time on device hits the host rejected "
+            "(gate false positives), by rule (top-K per scan)",
+            labelnames=("rule",),
+        )
 
     def observe_scan(self, ctx, seconds: float) -> None:
         """Fold one finished scan's trace context into the registry.
         snapshot() is reservoir-bounded: per-stage histogram counts are
         exact up to obs.RESERVOIR spans per stage per scan and a uniform
         sample beyond."""
+        from trivy_tpu.obs import profile as obs_profile
+
         self.scans.inc()
         self.scan_seconds.observe(seconds)
         for stage, durs in ctx.snapshot().items():
             for d in durs:
                 self.stage_seconds.observe(d, stage=stage)
         self.dedup_bytes.inc(ctx.counters.get("secret.bytes_dedup_hit", 0))
+        for rid, f in obs_profile.top_rules(ctx.merged_profile_dict()):
+            self.rule_gate_hits.inc(f.get("gate_hits", 0), rule=rid)
+            self.rule_confirm_seconds.inc(
+                f.get("confirm_ms", 0.0) / 1e3, rule=rid
+            )
+            self.rule_wasted_confirm_seconds.inc(
+                f.get("wasted_confirm_ms", 0.0) / 1e3, rule=rid
+            )
 
 
 class ScanServer:
@@ -163,7 +192,7 @@ class ScanServer:
 
     # -- service methods (JSON dict in/out) ---------------------------------
 
-    def scan(self, req: dict) -> dict:
+    def scan(self, req: dict, traceparent: str | None = None) -> dict:
         options = ScanOptions(
             scanners=req.get("Options", {}).get("Scanners", ["vuln"]),
             list_all_pkgs=bool(req.get("Options", {}).get("ListAllPkgs")),
@@ -171,24 +200,41 @@ class ScanServer:
         target = req.get("Target", "")
         # per-request trace context: concurrent scans record into disjoint
         # tables (each handler thread carries its own contextvar value), and
-        # the aggregates feed the shared /metrics registry afterwards
-        with obs.scan_context(name=f"server-scan:{target}", enabled=True) as ctx:
+        # the aggregates feed the shared /metrics registry afterwards. When
+        # the client sent a traceparent header, this request JOINS that
+        # trace — same trace id, root spans parented under the client's
+        # rpc.scan span — instead of minting a fresh context
+        joined = obs.parse_traceparent(traceparent)
+        with obs.scan_context(
+            name=f"server-scan:{target}",
+            enabled=True,
+            trace_id=joined[0] if joined else None,
+            parent_span_id=joined[1] if joined else None,
+        ) as ctx:
             with obs.heartbeat(
                 logger, f"scan of {target or '<unnamed>'}", HEARTBEAT_SECS
             ):
                 t0 = time.perf_counter()
-                results, os_info = self.driver.scan(
-                    target,
-                    req.get("ArtifactID", ""),
-                    list(req.get("BlobIDs", [])),
-                    options,
-                )
+                with ctx.span("server.scan"):
+                    results, os_info = self.driver.scan(
+                        target,
+                        req.get("ArtifactID", ""),
+                        list(req.get("BlobIDs", [])),
+                        options,
+                    )
                 dt = time.perf_counter() - t0
             self.metrics.observe_scan(ctx, dt)
-        return {
+        resp = {
             "OS": os_info.to_dict() if os_info else None,
             "Results": [r.to_dict() for r in results],
         }
+        if req.get("WantTrace"):
+            from trivy_tpu.obs import export as obs_export
+
+            # ship the span table back so the client's --trace-out emits
+            # one merged timeline and its report folds in the server stalls
+            resp["Trace"] = obs_export.context_doc(ctx)
+        return resp
 
     def put_blob(self, req: dict) -> dict:
         self.cache.put_blob(req["DiffID"], req["BlobInfo"])
@@ -332,7 +378,12 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 if reloader is not None:
                     reloader.request_begin()
                 try:
-                    resp = getattr(server, method)(req)
+                    if method == "scan":
+                        resp = server.scan(
+                            req, traceparent=self.headers.get("traceparent")
+                        )
+                    else:
+                        resp = getattr(server, method)(req)
                 finally:
                     if reloader is not None:
                         reloader.request_end()

@@ -59,6 +59,7 @@ from __future__ import annotations
 import hashlib
 import queue
 import threading
+import time
 from collections import OrderedDict, deque
 from collections.abc import Iterable, Iterator
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -401,6 +402,7 @@ class TpuSecretScanner:
         record = getattr(match, "record_result", None)
         stats = self.stats
         chunk_len = self.chunk_len
+        prof = ctx.profile() if ctx.enabled else None
 
         def rebatch(batch: np.ndarray, meta: list) -> np.ndarray:
             """Fresh bucket-padded copy of a failed batch's live rows — the
@@ -462,8 +464,16 @@ class TpuSecretScanner:
                 faults.check(
                     "device.fetch", key=f"d{didx if didx is not None else 0}"
                 )
+                t0 = time.perf_counter()
                 with ctx.span("secret.device_wait"):
                     arr = np.asarray(dev)
+                if prof is not None:
+                    # per-bucket dispatch cost: the bucket is the padded
+                    # batch shape (the compile-once ladder rung), rows are
+                    # the live rows it carried
+                    prof.bucket_dispatch(
+                        batch.shape[0], len(meta), time.perf_counter() - t0
+                    )
             except Exception as e:
                 if record is not None and didx is not None:
                     record(didx, False)
@@ -514,6 +524,10 @@ class TpuSecretScanner:
         # confirm pool record into it via obs.activate (worker threads do
         # not inherit the contextvar)
         ctx = obs.current()
+        # per-rule cost profile (gate hits here; confirm timing in the
+        # confirm pool); same enabled gate as spans
+        prof = ctx.profile() if ctx.enabled else None
+        rule_ids = self.compiled.rule_ids
         chunk_len = self.chunk_len
         dedup = self._dedup
         fp_key = self.ruleset_fingerprint
@@ -553,7 +567,7 @@ class TpuSecretScanner:
         def confirm_task(st: _FileState) -> Secret:
             try:
                 with obs.activate(ctx), ctx.span("secret.confirm"):
-                    return self._confirm(st)
+                    return self._confirm(st, prof)
             finally:
                 confirm_slots.release()
 
@@ -564,6 +578,11 @@ class TpuSecretScanner:
             windows (every row hit applies to every segment — cross-segment
             false candidates are discarded by the exact confirm), then
             retire each segment's pending count."""
+            if prof is not None and hit_rules:
+                # one logical device hit per (row, rule) — dedup-cache and
+                # coalesced rows count too: they cost a confirm all the same
+                for r in hit_rules:
+                    prof.gate_hit(rule_ids[r])
             for fidx, ws, we in segs:
                 st = states[fidx]
                 for r in hit_rules:
@@ -854,12 +873,12 @@ class TpuSecretScanner:
 
     # -- host confirmation --------------------------------------------------
 
-    def _confirm(self, st: _FileState) -> Secret:
+    def _confirm(self, st: _FileState, prof=None) -> Secret:
         # span recording happens in scan_files' confirm_task (which holds
         # the scan's trace context); direct callers time themselves
-        return self._confirm_inner(st)
+        return self._confirm_inner(st, prof)
 
-    def _confirm_inner(self, st: _FileState) -> Secret:
+    def _confirm_inner(self, st: _FileState, prof=None) -> Secret:
         windows_by_id = {
             self.compiled.rule_ids[i]: w for i, w in st.rules.items()
         }
@@ -871,6 +890,7 @@ class TpuSecretScanner:
         global_blocks = self.exact.global_block_spans(content)
         hits = []
         for rule in self.exact.rules_for_path(st.path):
+            t0 = time.perf_counter() if prof is not None else 0.0
             if rule.id in windows_by_id:
                 if rule.id in self._windowed_ids:
                     # regex runs only around the device-flagged chunk windows
@@ -890,5 +910,7 @@ class TpuSecretScanner:
                 )
             else:
                 continue
+            if prof is not None:
+                prof.confirm(rule.id, time.perf_counter() - t0, len(locs))
             hits.extend((rule, loc) for loc in locs)
         return self.exact.build_findings(st.path, content, hits)
