@@ -1,0 +1,375 @@
+"""Async zero-copy feed path (ISSUE 7 tentpole).
+
+Covers the pipeline rebuild of ``TpuSecretScanner.scan_files``: arena-slab
+reuse with findings parity (packing + dedup on), in-order emission under a
+deliberately slow reader, fault injection with the async in-flight window
+live, the empty/partial-final-slab guard (padding rows must not leak into
+dedup keys or retain arena slabs), and the walk→device streaming handoff
+(:class:`trivy_tpu.secret.feed.FileStream`).
+
+Scanners here run a RESTRICTED ruleset (two builtin rules) to keep device
+compiles cheap — full-ruleset feed parity is already exercised by
+test_tpu_scanner.py through the same pipeline.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tests.secret_samples import SAMPLES
+from trivy_tpu import faults
+from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
+from trivy_tpu.secret.feed import ChunkArena, FileStream
+from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+RESTRICTED = {"enable-builtin-rules": ["github-pat", "slack-access-token"]}
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return ScannerConfig.from_dict(RESTRICTED)
+
+
+@pytest.fixture(scope="module")
+def cpu(cfg):
+    return SecretScanner(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def corpus(n_big=12, n_small=6):
+    """Multi-chunk files + packable small files, secrets sprinkled in."""
+    rng = np.random.default_rng(11)
+    files = []
+    for i in range(n_big):
+        pad = rng.integers(97, 123, size=6000, dtype=np.uint8).tobytes()
+        body = pad
+        if i % 3 == 0:
+            body = SAMPLES["github-pat"].encode() + b"\n" + pad
+        files.append((f"big_{i}.txt", body))
+    for i in range(n_small):
+        files.append((f"small_{i}.h", f"// header {i}\n".encode() * 20))
+    files.append(("tok.h", f"a\n{SAMPLES['slack-access-token']}\nb\n".encode()))
+    return files
+
+
+def assert_parity(cpu, scanner, files):
+    got = list(scanner.scan_files(files))
+    assert len(got) == len(files)
+    for (path, data), secret in zip(files, got):
+        assert secret.to_dict() == cpu.scan_bytes(path, data).to_dict(), path
+    return got
+
+
+# -- arena ------------------------------------------------------------------
+
+
+def test_arena_reuse_parity(cfg, cpu):
+    """Far more batches than arena slabs: every slab is recycled many
+    times, findings stay byte-identical (pack + dedup on), and after the
+    scan every slab is back in the free list — an arena leak would walk
+    straight into the streaming-RSS gate."""
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    assert_parity(cpu, scanner, corpus())
+    st = scanner._last_feed_stats
+    assert st["arena_free"] == st["arena_slabs"]  # nothing retained
+    assert st["arena_acquires"] > st["arena_slabs"]  # slabs were reused
+    assert st["streams"] == 2
+
+
+def test_arena_acquire_release_contract():
+    a = ChunkArena(2, rows=4, row_len=16)
+    i0, s0 = a.acquire()
+    i1, s1 = a.acquire()
+    assert {i0, i1} == {0, 1} and s0.shape == (4, 16)
+    # exhausted arena + abort predicate: returns None instead of hanging
+    assert a.acquire(abort=lambda: True, poll=0.01) is None
+    a.release(i0)
+    assert a.acquire()[0] == i0
+    with pytest.raises(ValueError):
+        a.release(i1)  # still held is fine ...
+        a.release(i1)  # ... double release is not
+
+
+def test_partial_final_slab_no_padding_leak(cfg, cpu):
+    """A final partial slab is bucket-padded with stale rows; those
+    padding rows must not acquire dedup keys (satellite fix). Every live
+    row's digest — and ONLY live rows' digests — lands in the hit LRU."""
+    chunk = 1024
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=chunk, batch_size=4, pack_small=False,
+        feed_streams=1, inflight=1,
+    )
+    rng = np.random.default_rng(5)
+    # 6 one-row files -> one full batch of 4 + a partial batch of 2
+    files = [
+        (f"f{i}.bin", rng.integers(32, 127, chunk, np.uint8).tobytes())
+        for i in range(6)
+    ]
+    assert_parity(cpu, scanner, files)
+    s = scanner.stats.snapshot()
+    assert s["chunks"] == 6 and s["chunks_uploaded"] == 6
+    # exactly the 6 live rows were hashed into the dedup cache — a leak of
+    # the 2 stale padding rows of the final slab would add extra entries
+    assert len(scanner._hit_lru) == 6
+    assert scanner._last_feed_stats["arena_free"] == (
+        scanner._last_feed_stats["arena_slabs"]
+    )
+
+
+def test_empty_final_slab_never_dispatched(cfg, cpu):
+    """Input an exact multiple of the batch size: the trailing slab holds
+    zero live rows and must not be dispatched (no padding-only upload)."""
+    chunk = 1024
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=chunk, batch_size=4, pack_small=False,
+        feed_streams=1, inflight=1,
+    )
+    rng = np.random.default_rng(6)
+    files = [
+        (f"g{i}.bin", rng.integers(32, 127, chunk, np.uint8).tobytes())
+        for i in range(4)
+    ]
+    assert_parity(cpu, scanner, files)
+    s = scanner.stats.snapshot()
+    assert s["bytes_uploaded"] == 4 * chunk  # one bucket, no empty batch
+    assert scanner._last_feed_stats["arena_acquires"] == 1
+
+
+# -- emission order ---------------------------------------------------------
+
+
+def test_inorder_emission_slow_reader(cfg, cpu):
+    """A deliberately slow reader (the input trickles in) must not break
+    in-order emission or parity — the feeder consumes the iterable on its
+    own thread and the reorder buffer holds completions."""
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    files = corpus(n_big=8, n_small=4)
+
+    def slow():
+        for f in files:
+            time.sleep(0.005)
+            yield f
+
+    got = list(scanner.scan_files(slow()))
+    assert [s.file_path for s in got] == [p for p, _ in files]
+    for (path, data), secret in zip(files, got):
+        assert secret.to_dict() == cpu.scan_bytes(path, data).to_dict(), path
+
+
+def test_slow_consumer_does_not_stall_feeder(cfg):
+    """The generator's consumer sleeping on a head-of-line result must not
+    stop the feeder: by the time the slow first next() returns, the
+    pipeline should have progressed well past the first file."""
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    consumed = []
+    files = corpus(n_big=10, n_small=2)
+
+    def tracking():
+        for i, f in enumerate(files):
+            consumed.append(i)
+            yield f
+
+    it = scanner.scan_files(tracking())
+    first = next(it)
+    time.sleep(0.3)  # consumer dawdles; feeder keeps running
+    assert len(consumed) == len(files)  # fully ingested despite no next()
+    rest = list(it)
+    assert first.file_path == files[0][0]
+    assert len(rest) == len(files) - 1
+    assert scanner._last_feed_stats["arena_free"] == (
+        scanner._last_feed_stats["arena_slabs"]
+    )
+
+
+# -- faults with the async window in flight ---------------------------------
+
+
+def test_dispatch_fault_recovers_with_async_window(cfg, cpu):
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    s0 = scanner.stats.snapshot()
+    faults.configure("device.dispatch:at=2")
+    assert_parity(cpu, scanner, corpus())
+    s1 = scanner.stats.snapshot()
+    assert s1["batch_retries"] - s0["batch_retries"] >= 1
+    assert s1["degraded"] == s0["degraded"]
+
+
+def test_oom_split_with_async_window(cfg, cpu):
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=8, feed_streams=2, inflight=2
+    )
+    faults.configure("device.dispatch:at=1:error=oom")
+    assert_parity(cpu, scanner, corpus())
+    assert scanner.stats.snapshot()["batch_splits"] >= 1
+
+
+def test_permanent_fault_degrades_mid_stream(cfg, cpu):
+    """Device dies while the async window is full and the input is half
+    read: every file still emits, in order, byte-identical (host
+    fallback), and the arena comes back whole."""
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    files = corpus(n_big=16, n_small=4)
+    faults.configure("device.dispatch:at=3:times=-1")
+    got = list(scanner.scan_files(iter(files)))
+    assert len(got) == len(files)
+    for (path, data), secret in zip(files, got):
+        assert secret.to_dict() == cpu.scan_bytes(path, data).to_dict(), path
+    assert scanner.stats.snapshot()["degraded"] >= 1
+    assert scanner._last_feed_stats["arena_free"] == (
+        scanner._last_feed_stats["arena_slabs"]
+    )
+
+
+def test_input_iterator_error_propagates(cfg):
+    """An exception thrown by the input iterable (a dying reader) must
+    surface to the consumer, not vanish behind a truncated-but-"complete"
+    file count."""
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+
+    def bad():
+        yield ("a.txt", b"x" * 3000)
+        raise OSError("reader blew up")
+
+    with pytest.raises(OSError, match="reader blew up"):
+        list(scanner.scan_files(bad()))
+
+
+def test_no_host_fallback_raises_through_pipeline(cfg):
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, host_fallback=False,
+        feed_streams=2, inflight=2,
+    )
+    faults.configure("device.dispatch:times=-1")
+    with pytest.raises(faults.InjectedFault):
+        list(scanner.scan_files(corpus()))
+
+
+# -- FileStream (walk → device handoff) -------------------------------------
+
+
+def test_file_stream_round_trip_and_backpressure():
+    stream = FileStream(max_bytes=64)  # tiny budget: forces backpressure
+    items = [(f"f{i}", bytes([65 + i]) * 40) for i in range(8)]
+    got = []
+    consumer = threading.Thread(
+        target=lambda: got.extend(stream), daemon=True
+    )
+    consumer.start()
+    for p, d in items:
+        stream.put(p, d)  # blocks whenever >64 bytes are queued
+    stream.close()
+    consumer.join(timeout=10)
+    assert got == items
+
+
+def test_file_stream_fail_unblocks_producer():
+    stream = FileStream(max_bytes=16)
+    stream.put("a", b"x" * 16)  # budget now full
+    boom = RuntimeError("scan thread died")
+
+    def poison():
+        time.sleep(0.05)
+        stream.fail(boom)
+
+    threading.Thread(target=poison, daemon=True).start()
+    with pytest.raises(RuntimeError, match="scan thread died"):
+        stream.put("b", b"y" * 16)  # would block forever without fail()
+
+
+def test_streaming_analyzer_parity(cfg, cpu, tmp_path):
+    """The analyzer's streaming handoff (collect → FileStream → background
+    scan_files) yields the same findings as scanning the bytes directly."""
+    from trivy_tpu import obs
+    from trivy_tpu.fanal.analyzers.secret import _StreamScan
+
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    files = corpus(n_big=6, n_small=3)
+    scan = _StreamScan(scanner, obs.current())
+    for p, d in files:
+        scan.put(p, d)
+    found = scan.finish()
+    want = {
+        p: cpu.scan_bytes(p, d).to_dict()
+        for p, d in files
+        if cpu.scan_bytes(p, d).findings
+    }
+    assert {s.file_path: s.to_dict() for s in found} == want
+
+
+def test_no_fallback_analyzer_failure_is_loud(cfg, tmp_path, monkeypatch):
+    """--no-host-fallback through the ANALYZER surface: the device failure
+    must fail the artifact scan (FatalAnalyzerError re-raised by the
+    group's containment layers), not degrade into a warning plus a
+    'clean' report with every finding dropped."""
+    from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.fanal.analyzers import secret as secret_analyzer
+
+    (tmp_path / "cred.txt").write_text(
+        f"token {SAMPLES['github-pat']}\n" + "pad\n" * 400
+    )
+    monkeypatch.setattr(secret_analyzer, "_scanner_cache", {})
+    faults.configure("device.dispatch:times=-1")
+    opt = ArtifactOption(analyzer_extra={
+        "host_fallback": False, "secret_streams": 2, "secret_inflight": 2,
+    })
+    art = LocalFSArtifact(str(tmp_path), new_cache("memory"), opt)
+    with pytest.raises(faults.InjectedFault):
+        art.inspect()
+
+
+def test_streaming_analyzer_abort_releases_pipeline(cfg):
+    """A walk that dies mid-scan aborts the streaming scan: the consumer
+    thread exits and every arena slab returns (no leak into a long-lived
+    server process)."""
+    from trivy_tpu import obs
+    from trivy_tpu.fanal.analyzers.secret import _StreamScan
+
+    scanner = TpuSecretScanner(
+        cfg, chunk_len=1024, batch_size=4, feed_streams=2, inflight=2
+    )
+    scan = _StreamScan(scanner, obs.current())
+    for p, d in corpus(n_big=4, n_small=2):
+        scan.put(p, d)
+    scan.abort()
+    assert not scan.thread.is_alive()
+    assert scan.found == []
+    st = scanner._last_feed_stats
+    assert st["arena_free"] == st["arena_slabs"]
+    # the scanner stays usable for the next scan
+    assert list(scanner.scan_files([("ok.txt", b"clean enough\n" * 10)]))
+
+
+# -- knobs ------------------------------------------------------------------
+
+
+def test_feed_knobs_resolve(cfg, monkeypatch):
+    s = TpuSecretScanner(cfg, chunk_len=1024, batch_size=4,
+                         feed_streams=3, inflight=5)
+    assert s.feed_streams == 3 and s.inflight == 5
+    monkeypatch.setenv("TRIVY_TPU_FEED_STREAMS", "6")
+    monkeypatch.setenv("TRIVY_TPU_FEED_INFLIGHT", "7")
+    s = TpuSecretScanner(cfg, chunk_len=1024, batch_size=4)
+    assert s.feed_streams == 6 and s.inflight == 7

@@ -217,6 +217,11 @@ class ImageArchiveArtifact:
                         continue
                     for t, content in wanted.items():
                         post_files.setdefault(t, {})[rel] = content
+            except BaseException:
+                # a dying layer walk must not leak the analyzers' streaming
+                # device scans (threads + arena slabs)
+                group.abort()
+                raise
             finally:
                 stream.close()
             group.finalize(result, post_files)

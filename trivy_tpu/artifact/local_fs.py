@@ -96,12 +96,20 @@ class LocalFSArtifact:
         n_analyzed = [0]  # mutable: read by the heartbeat thread
         ctx = obs.current()
 
+        enabled = ctx.enabled
+
         def analyze(rel, info, fut):
-            def load():
-                # time blocked on the read-ahead pool: if this dominates,
-                # the scan is I/O-bound, not analyzer/device-bound
-                with ctx.span("fs.read_wait"):
-                    return fut.result()
+            if enabled:
+                def load():
+                    # time blocked on the read-ahead pool: if this
+                    # dominates, the scan is I/O-bound, not
+                    # analyzer/device-bound
+                    with ctx.span("fs.read_wait"):
+                        return fut.result()
+            else:
+                # zero-cost-when-off: no per-file span closure on the
+                # untraced hot path
+                load = fut.result
 
             try:
                 wanted = self.group.analyze_file(
@@ -117,35 +125,45 @@ class LocalFSArtifact:
             n_analyzed[0] += 1
 
         # overlap file reads with analysis: a reader pool prefetches contents
-        # ahead of the (serial) analyzer loop — the TPU-era equivalent of the
-        # reference's per-file goroutine fan-out (ref: analyzer.go:403-455),
-        # restructured as read-ahead feeding batched device collection
+        # ahead of the analyzer loop — the TPU-era equivalent of the
+        # reference's per-file goroutine fan-out (ref: analyzer.go:403-455).
+        # Batched analyzers (secret) now consume these bytes through their
+        # own streaming handoff, so the walk, the reads, and the device
+        # pipeline all overlap; the read-ahead window is the walk-side
+        # bound, the analyzer's stream budget the device-side one.
         workers = self.option.parallel or DEFAULT_PARALLEL
-        with obs.heartbeat(
-            logger,
-            f"fs scan of {self.root}",
-            interval=30.0,
-            progress=lambda: f"{n_analyzed[0]} files analyzed",
-        ), ThreadPoolExecutor(max_workers=workers) as pool:
-            window: deque = deque()  # (rel, info, future)
-            buffered = 0
-            for rel, info, opener in self.walker.walk(self.root):
-                n_files += 1
-                window.append((rel, info, pool.submit(opener)))
-                buffered += info.size
-                while (
-                    buffered > self.PREFETCH_BYTES
-                    or len(window) > self.PREFETCH_FILES
-                ):
+        prefetch_files = max(self.PREFETCH_FILES, workers * 16)
+        try:
+            with obs.heartbeat(
+                logger,
+                f"fs scan of {self.root}",
+                interval=30.0,
+                progress=lambda: f"{n_analyzed[0]} files analyzed",
+            ), ThreadPoolExecutor(max_workers=workers) as pool:
+                window: deque = deque()  # (rel, info, future)
+                buffered = 0
+                for rel, info, opener in self.walker.walk(self.root):
+                    n_files += 1
+                    window.append((rel, info, pool.submit(opener)))
+                    buffered += info.size
+                    while (
+                        buffered > self.PREFETCH_BYTES
+                        or len(window) > prefetch_files
+                    ):
+                        r, i, fut = window.popleft()
+                        buffered -= i.size
+                        analyze(r, i, fut)
+                while window:
                     r, i, fut = window.popleft()
-                    buffered -= i.size
                     analyze(r, i, fut)
-            while window:
-                r, i, fut = window.popleft()
-                analyze(r, i, fut)
-            # batched analyzers hit the device here (secret/license batches)
-            with ctx.span("fs.batch_analyze"):
-                self.group.finalize(result, post_files)
+                # batched analyzers join their streaming device scans here
+                with ctx.span("fs.batch_analyze"):
+                    self.group.finalize(result, post_files)
+        except BaseException:
+            # a dying walk must not leak the analyzers' background device
+            # pipelines (threads + arena slabs)
+            self.group.abort()
+            raise
         blob = result.to_blob_info()
         self.handlers.post_handle(result, blob)
         blob_dict = blob.to_dict()

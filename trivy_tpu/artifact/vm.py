@@ -83,19 +83,25 @@ class VMImageArtifact:
         n_files = 0
         skips = set(self.option.skip_files)
         skip_dirs = [d.strip("/") + "/" for d in self.option.skip_dirs]
-        for _part, fpath, size, opener in walk_disk(self.path):
-            if fpath in skips or any(fpath.startswith(d) for d in skip_dirs):
-                continue
-            n_files += 1
-            info = FileInfo(size=size, mode=0o644)
-            try:
-                wanted = self.group.analyze_file(result, "", fpath, info, opener)
-            except OSError as e:
-                note_file_skipped(fpath, e)
-                continue
-            for t, content in wanted.items():
-                post_files.setdefault(t, {})[fpath] = content
-        self.group.finalize(result, post_files)
+        try:
+            for _part, fpath, size, opener in walk_disk(self.path):
+                if fpath in skips or any(fpath.startswith(d) for d in skip_dirs):
+                    continue
+                n_files += 1
+                info = FileInfo(size=size, mode=0o644)
+                try:
+                    wanted = self.group.analyze_file(result, "", fpath, info, opener)
+                except OSError as e:
+                    note_file_skipped(fpath, e)
+                    continue
+                for t, content in wanted.items():
+                    post_files.setdefault(t, {})[fpath] = content
+            self.group.finalize(result, post_files)
+        except BaseException:
+            # a dying disk walk must not leak the analyzers' streaming
+            # device scans (threads + arena slabs)
+            self.group.abort()
+            raise
         blob = result.to_blob_info()
         self.handlers.post_handle(result, blob)
         self.cache.put_blob(blob_id, blob.to_dict())

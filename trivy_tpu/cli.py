@@ -151,6 +151,14 @@ def secret_flags() -> FlagGroup:
                  config_name="secret.hit-cache",
                  help="persist chunk hit vectors in the scan cache backend "
                       "(fs/redis) for cross-scan dedup"),
+            Flag("secret-streams", default=0, value_type=int,
+                 config_name="secret.streams",
+                 help="transfer streams feeding the device (0 = auto: one "
+                      "per device, several on a single accelerator)"),
+            Flag("secret-inflight", default=0, value_type=int,
+                 config_name="secret.inflight",
+                 help="batches in flight per transfer stream "
+                      "(0 = auto: 2, double-buffered)"),
         ],
     )
 

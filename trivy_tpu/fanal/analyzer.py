@@ -207,6 +207,18 @@ class Analyzer:
         raise NotImplementedError
 
 
+class FatalAnalyzerError(Exception):
+    """An analyzer failure that must fail the whole scan instead of being
+    contained to one analyzer/file — e.g. a ``--no-host-fallback`` device
+    error, where the user explicitly asked for loud failure. The group's
+    containment layers (per-file collect, finalize) re-raise this where
+    they swallow everything else."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class BatchAnalyzer:
     """TPU-first batched analyzer: collect during the walk, analyze once.
 
@@ -226,6 +238,13 @@ class BatchAnalyzer:
 
     def finalize(self) -> AnalysisResult | None:
         raise NotImplementedError
+
+    def abort(self) -> None:
+        """Tear down without producing a result — called when the walk
+        dies before ``finalize``. Default no-op; analyzers that hold
+        background resources (the secret analyzer's streaming device
+        scan) override it so an aborted artifact scan can't leak threads
+        or arena memory."""
 
 
 class PostAnalyzer:
@@ -350,6 +369,8 @@ class AnalyzerGroup:
                 )
             except FileReadError:
                 raise
+            except FatalAnalyzerError as e:
+                raise e.cause from None  # the user asked for loud failure
             except Exception as e:
                 logger.warning("collector %s failed on %s: %s", a.type.value, file_path, e)
         for a in self.post_analyzers:
@@ -362,6 +383,8 @@ class AnalyzerGroup:
         for a in self.batch_analyzers:
             try:
                 result.merge(a.finalize())
+            except FatalAnalyzerError as e:
+                raise e.cause from None  # the user asked for loud failure
             except Exception as e:
                 logger.warning("batch analyzer %s failed: %s", a.type.value, e)
         for a in self.post_analyzers:
@@ -372,3 +395,14 @@ class AnalyzerGroup:
                 result.merge(a.post_analyze(files))
             except Exception as e:
                 logger.warning("post-analyzer %s failed: %s", a.type.value, e)
+
+    def abort(self) -> None:
+        """Tear down batched analyzers without finalizing — the artifact
+        layer calls this when a walk dies mid-scan so background device
+        pipelines shut down instead of leaking."""
+        for a in self.batch_analyzers:
+            try:
+                a.abort()
+            except Exception as e:
+                logger.warning("batch analyzer %s abort failed: %s",
+                               a.type.value, e)

@@ -1,4 +1,4 @@
-"""Secret analyzer: file eligibility + batched device scanning.
+"""Secret analyzer: file eligibility + streaming device scanning.
 
 Mirrors the reference's pre-filters exactly (ref:
 pkg/fanal/analyzer/secret/secret.go:152-190 — min size 10 bytes, skip dirs
@@ -6,13 +6,19 @@ pkg/fanal/analyzer/secret/secret.go:152-190 — min size 10 bytes, skip dirs
 paths) and its content normalization (ref: secret.go:103-150 — binary sniff
 with printable-strings fallback for allowed binaries, CR stripping, leading
 '/' for image layers). The scan itself is the TPU-first divergence: files
-are *collected* during the walk and shipped to the device in chunk batches
-via TpuSecretScanner (exact host confirm keeps findings byte-identical).
+*stream* from the walk into a persistent ``TpuSecretScanner.scan_files``
+call running on a background thread (a byte-bounded
+:class:`trivy_tpu.secret.feed.FileStream` is the handoff), so walking and
+reading overlap chunking, transfers, and device matching instead of
+alternating in buffer-sized bursts — the reference's walker-goroutines →
+bounded-channel → workers shape, with the device pipeline as the worker
+pool. Exact host confirm keeps findings byte-identical.
 """
 
 from __future__ import annotations
 
 import os.path
+import threading
 
 from trivy_tpu.fanal import utils
 from trivy_tpu.fanal.analyzer import (
@@ -39,13 +45,13 @@ _scanner_cache: dict = {}
 def _shared_scanner(
     config, backend: str, parallel: int,
     dedup: bool = True, pack_small: bool = True, hit_cache=None,
-    host_fallback: bool = True,
+    host_fallback: bool = True, feed_streams: int = 0, inflight: int = 0,
 ):
     key = (
         id(config) if config is not None else None,
         backend, parallel, dedup, pack_small,
         id(hit_cache) if hit_cache is not None else None,
-        host_fallback,
+        host_fallback, feed_streams, inflight,
     )
     with _scanner_lock:
         if key not in _scanner_cache:
@@ -60,6 +66,7 @@ def _shared_scanner(
                         config, backend=backend, confirm_workers=parallel,
                         dedup=dedup, pack_small=pack_small,
                         hit_cache=hit_cache, host_fallback=host_fallback,
+                        feed_streams=feed_streams, inflight=inflight,
                     )
                 except Exception as e:
                     # --backend failed at init (jax import, device probe,
@@ -101,9 +108,61 @@ SKIP_EXTS = {
 ALLOWED_BINARIES = {".pyc"}
 
 LARGE_FILE_WARN = 10 * 1024 * 1024  # ref: secret.go:110
-# flush collected files to the device once this much content is buffered,
-# bounding host memory on large trees
-BATCH_FLUSH_BYTES = 64 * 1024 * 1024
+# byte budget of the walk→device handoff stream: the walk blocks once this
+# much collected content is waiting on the device pipeline, bounding host
+# memory on large trees (formerly the synchronous 64 MB flush batch)
+STREAM_BUFFER_BYTES = 64 * 1024 * 1024
+
+
+class _StreamScan:
+    """One walk's background device scan: a byte-bounded FileStream feeds
+    a persistent ``scan_files`` call on a worker thread, so collection
+    (walk + read) and device scanning overlap. ``finish`` closes the
+    stream, joins the consumer, and re-raises any scan failure."""
+
+    def __init__(self, scanner, ctx):
+        from trivy_tpu.secret.feed import FileStream
+
+        self.stream = FileStream(STREAM_BUFFER_BYTES)
+        self.found: list = []
+        self.error: BaseException | None = None
+        self._scanner = scanner
+        self._ctx = ctx
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name="secret-stream-scan"
+        )
+        self.thread.start()
+
+    def _run(self) -> None:
+        from trivy_tpu import obs
+
+        try:
+            with obs.activate(self._ctx):
+                for s in self._scanner.scan_files(self.stream):
+                    if s.findings:
+                        self.found.append(s)
+        except BaseException as e:
+            self.error = e
+            # unblock (and poison) any producer waiting on the byte budget
+            self.stream.fail(e)
+
+    def put(self, path: str, content: bytes) -> None:
+        self.stream.put(path, content)
+
+    def finish(self) -> list:
+        self.stream.close()
+        self.thread.join()
+        if self.error is not None:
+            raise self.error
+        return self.found
+
+    def abort(self) -> None:
+        """End the background scan without results: poisoning the stream
+        ends the feeder's input, the pipeline drains, and the consumer
+        thread exits — no leaked threads or arena slabs."""
+        self.stream.fail(RuntimeError("artifact scan aborted"))
+        self.thread.join(timeout=10.0)
+        self.found = []
 
 
 class SecretAnalyzer(BatchAnalyzer):
@@ -139,9 +198,11 @@ class SecretAnalyzer(BatchAnalyzer):
         # --no-host-fallback: fail the scan on device errors instead of
         # degrading to the exact host path (CI parity gates want loud)
         self._host_fallback = bool(extra.get("host_fallback", True))
+        # async feed-path knobs (--secret-streams / --secret-inflight)
+        self._feed_streams = int(extra.get("secret_streams", 0) or 0)
+        self._inflight = int(extra.get("secret_inflight", 0) or 0)
         self._scanner = None  # built lazily so CPU-only runs never touch jax
-        self._files: list[tuple[str, bytes]] = []
-        self._buffered = 0
+        self._stream: _StreamScan | None = None
         self._found: list = []
 
     def required(self, file_path: str, info) -> bool:
@@ -170,6 +231,7 @@ class SecretAnalyzer(BatchAnalyzer):
                 dedup=self._dedup, pack_small=self._pack,
                 hit_cache=self._hit_cache,
                 host_fallback=self._host_fallback,
+                feed_streams=self._feed_streams, inflight=self._inflight,
             )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 
@@ -195,27 +257,54 @@ class SecretAnalyzer(BatchAnalyzer):
             content = utils.extract_printable_bytes(inp.content)
         else:
             content = inp.content.replace(b"\r", b"")
-        self._files.append((self._normalize(inp.file_path, inp.dir), content))
-        self._buffered += len(content)
-        if self._buffered >= BATCH_FLUSH_BYTES:
-            self._flush()
-
-    def _flush(self) -> None:
-        if not self._files:
-            return
-        files, self._files, self._buffered = self._files, [], 0
+        path = self._normalize(inp.file_path, inp.dir)
         self._exact()  # ensure scanner exists
         scanner = self._scanner
-        if hasattr(scanner, "scan_files"):
-            secrets = scanner.scan_files(files)
-        else:
-            secrets = (scanner.scan_bytes(p, d) for p, d in files)
-        self._found.extend(s for s in secrets if s.findings)
+        if not hasattr(scanner, "scan_files"):
+            # plain host engine: scan inline, nothing worth overlapping
+            s = scanner.scan_bytes(path, content)
+            if s.findings:
+                self._found.append(s)
+            return
+        if self._stream is None:
+            from trivy_tpu import obs
+
+            # the background consumer re-enters this walk's trace context
+            self._stream = _StreamScan(scanner, obs.current())
+        # blocks only once STREAM_BUFFER_BYTES of content is waiting on
+        # the device pipeline (walk-side backpressure); raises the scan
+        # thread's error instead of buffering into a dead pipeline
+        try:
+            self._stream.put(path, content)
+        except Exception as e:
+            self._raise_scan_error(e)
+
+    def _raise_scan_error(self, e: Exception) -> None:
+        """With ``--no-host-fallback`` the user asked device failures to be
+        loud: wrap so the analyzer group's containment layers re-raise
+        instead of downgrading the failure to a warning (which would report
+        a 'clean' scan with every secret finding silently dropped)."""
+        from trivy_tpu.fanal.analyzer import FatalAnalyzerError
+
+        if not self._host_fallback:
+            raise FatalAnalyzerError(e) from e
+        raise e
 
     def finalize(self) -> AnalysisResult | None:
-        self._flush()
+        if self._stream is not None:
+            stream, self._stream = self._stream, None
+            try:
+                self._found.extend(stream.finish())
+            except Exception as e:
+                self._raise_scan_error(e)
         found, self._found = self._found, []
         return AnalysisResult(secrets=found) if found else AnalysisResult()
+
+    def abort(self) -> None:
+        if self._stream is not None:
+            stream, self._stream = self._stream, None
+            stream.abort()
+        self._found = []
 
 
 register_analyzer(SecretAnalyzer)

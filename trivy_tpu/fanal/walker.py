@@ -16,6 +16,7 @@ where the read happens).
 
 from __future__ import annotations
 
+import functools
 import os
 import re
 import time
@@ -38,11 +39,15 @@ class WalkOption:
     size_threshold: int = DEFAULT_SIZE_THRESHOLD
 
 
+@functools.lru_cache(maxsize=None)
 def _glob_to_re(pat: str) -> "re.Pattern":
     """doublestar-style glob -> regex: ``*``/``?`` never cross ``/``,
     ``**`` crosses any number of segments (ref: pkg/fanal/utils/utils.go:117
     uses doublestar.Match — plain fnmatch would over-match and silently
-    drop nested files from the scan)."""
+    drop nested files from the scan). Cached: the walk calls this for
+    every (file, pattern) pair, and recompiling the same handful of skip
+    patterns per directory entry was pure host-feed overhead (the pattern
+    set is user-config-sized, so the cache is inherently bounded)."""
     out = []
     i = 0
     while i < len(pat):

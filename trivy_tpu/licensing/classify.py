@@ -44,8 +44,8 @@ _SPDX_URL = "https://spdx.org/licenses/{}.html"
 # cap on gram rows per device dispatch; the bucket ladder pads row counts
 # to powers of two below this so every dispatch shape compiles exactly once
 MAX_DEVICE_ROWS = 1024
-# batches in flight before the oldest result is fetched (mirrors
-# secret.tpu_scanner.PIPELINE_DEPTH)
+# batches in flight before the oldest result is fetched (the license
+# analog of the secret scanner's per-stream FEED_INFLIGHT window)
 DEVICE_PIPELINE_DEPTH = 3
 # below this many texts the fixed dispatch overhead beats the device win
 DEVICE_MIN_TEXTS = 8

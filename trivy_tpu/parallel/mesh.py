@@ -218,6 +218,34 @@ def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
     return run
 
 
+def single_stream_match_fn(match_fn):
+    """Uniform dispatch surface for the single-stream kernel paths (plain
+    XLA graph fn, rows-multiple pallas wrapper, mesh-sharded shard_map).
+
+    The secret scanner's transfer workers drive every dispatch flavor
+    through the same ``run.dispatch(chunks) -> (async_result, device_idx)``
+    API that :func:`round_robin_match_fn` exposes; this wrapper gives the
+    one-stream paths that API (device index fixed at 0) and owns the
+    ``device.dispatch`` fault-injection gate for them, so the per-batch
+    retry ladder sees identical failure shapes on every path. Multiple
+    worker threads may call ``dispatch`` concurrently: jax dispatch is
+    async and thread-safe, which is exactly how transfers for batch N+1
+    overlap the kernel for batch N on a single device.
+    """
+
+    def dispatch(chunks: np.ndarray):
+        faults.check("device.dispatch", key="d0")
+        return match_fn(chunks), 0
+
+    def run(chunks: np.ndarray):
+        return dispatch(chunks)[0]
+
+    # deliberately no ``n_streams``: its presence is how callers (and
+    # tests) distinguish real multi-device round-robin dispatch
+    run.dispatch = dispatch
+    return run
+
+
 def round_robin_match_fn(
     match_fn, devices=None, rows_multiple: int = 1, breaker: CircuitBreaker | None = None
 ):
@@ -231,7 +259,10 @@ def round_robin_match_fn(
     bandwidth multiplies by the device count. No collectives are involved;
     each dispatch is an independent per-device program (jit compiles one
     executable per placement), and callers fetch results in dispatch order
-    exactly as with the single-device path.
+    exactly as with the single-device path. ``dispatch`` is thread-safe —
+    the secret scanner runs one transfer-worker thread per device so the
+    per-device host→device copies themselves overlap, not just the
+    transfer-vs-kernel phases.
 
     Failure domain: a :class:`CircuitBreaker` (``run.breaker``) excludes a
     device from the rotation after K consecutive failures and re-probes it

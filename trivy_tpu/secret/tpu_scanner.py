@@ -15,14 +15,36 @@ match — see ``_windowed_ids``); other keyword-lane rules rescan the whole
 file on flag, with unbounded-width regexes accelerated by their bounded
 start-detector prefix (``Rule.start_detector``).
 
-Batches are dispatched asynchronously (JAX dispatch is async by default)
-through a depth-PIPELINE_DEPTH pipeline: the host packs batches N+1..N+k
-while the device matches batch N — the TPU analog of the reference's
-`parallel.Pipeline` feeder/worker split (ref: pkg/parallel/pipeline.go:14-115).
+The feed path is a fully asynchronous pipeline — the TPU analog of the
+reference's walker-goroutine fan-out into a bounded channel
+(`parallel.Pipeline`, ref: pkg/parallel/pipeline.go:14-115,
+scan_flags.go:79-84):
+
+  input thread (feeder): chunk / hash / dedup / pack into a fixed
+  **chunk arena** of preallocated reusable row slabs
+  (:class:`trivy_tpu.secret.feed.ChunkArena`) — large files gather all
+  their full rows into a slab with ONE vectorized strided copy, counters
+  accumulate per file, not per row
+    → bounded dispatch queue
+  **transfer streams** (N worker threads, one per round-robin device, ≥2
+  on a single device): each keeps a bounded in-flight window of
+  double-buffered dispatches (`jax.device_put` + kernel enqueue are
+  async), so batch N+1's host→device transfer overlaps batch N's kernel
+  AND the per-stream transfers overlap each other — on a serialized
+  tunnel link this multiplies effective feed bandwidth by the stream
+  count; slabs release back to the arena only after the blocking fetch,
+  then results resolve inline
+    → confirm pool (bounded by a semaphore)
+    → **reorder buffer**: the generator emits per-file results in input
+  order from a completion map, so a slow head-of-line confirmation never
+  stalls the feeder — readers keep filling the arena while emission
+  waits.
+
 Dispatch shapes are drawn from a fixed bucket ladder (B, B/2, B/4, ...) so
-every shape compiles exactly once; exact host confirmation runs in a small
-thread pool that overlaps with the blocking device-result fetches (which
-release the GIL).
+every shape compiles exactly once. Arena slots bound host memory (slabs in
+the dispatch queue + per-stream windows + assembly margin); the confirm
+semaphore bounds retained file bytes; together they are the streaming-RSS
+guarantee the bench gate enforces.
 
 The feed path sends link bytes ≪ corpus bytes (the host→device link, not
 the kernel, is the e2e ceiling):
@@ -57,6 +79,7 @@ degraded.
 from __future__ import annotations
 
 import hashlib
+import os
 import queue
 import threading
 import time
@@ -70,6 +93,7 @@ import numpy as np
 from trivy_tpu import faults, log, obs
 from trivy_tpu.ops.match import build_match_fn
 from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
+from trivy_tpu.secret.feed import ChunkArena, row_windows
 from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
 from trivy_tpu.types import Secret
 
@@ -85,8 +109,25 @@ DEFAULT_BATCH = 64
 # spill cliff that 16 KiB rows hit
 PALLAS_CHUNK_LEN = 8192
 PALLAS_BATCH = 1024
-# batches in flight before the oldest result is fetched
-PIPELINE_DEPTH = 3
+# per-transfer-stream in-flight window: 2 = double buffering (batch N+1's
+# transfer overlaps batch N's kernel on the same stream)
+FEED_INFLIGHT = 2
+# assembled slabs queued between the feeder and the transfer streams
+FEED_QUEUE_DEPTH = 2
+# arena slack beyond queue + windows: the slab being assembled + one spare
+ARENA_MARGIN = 2
+# transfer streams on a single non-CPU device when nothing else decides it:
+# the axon-tunnel link serializes per transfer, so concurrent device_put
+# calls from separate threads are the only way past the one-stream ceiling.
+# Known tradeoff: the old single-thread loop existed because the axon
+# tunnel's replay journal was measured to retain ~0.9 byte/byte scanned
+# when transfers and fetches interleave across threads — multi-stream
+# dispatch re-accepts that interleaving to buy link bandwidth. It is
+# guarded rather than hidden: the bench streaming child runs with
+# AXON_JOURNAL_COMPACT=1 (journal stays flat) and its RSS gate fails loud;
+# TRIVY_TPU_FEED_STREAMS=1 restores the serialized behavior if a
+# deployment hits journal growth
+SINGLE_DEVICE_STREAMS = 4
 # workers for exact host confirmation (overlaps device-result waits)
 CONFIRM_WORKERS = 4
 # bounded in-process LRU for the chunk-dedup hit cache; most entries are an
@@ -200,6 +241,10 @@ class TpuSecretScanner:
         host_fallback: bool = True,  # degrade to the exact host path on
         # unrecoverable device failure instead of failing the scan
         batch_retries: int = BATCH_RETRIES,
+        feed_streams: int = 0,  # transfer-stream worker threads; 0 = auto
+        # (one per round-robin device; SINGLE_DEVICE_STREAMS on one
+        # accelerator; 2 on the CPU backend)
+        inflight: int = 0,  # in-flight batches per stream; 0 = FEED_INFLIGHT
     ):
         import jax
 
@@ -271,43 +316,68 @@ class TpuSecretScanner:
             pad_batch,
             round_robin_match_fn,
             sharded_match_fn,
+            single_stream_match_fn,
         )
 
         if dispatch not in ("auto", "single", "round_robin"):
             raise ValueError(
                 f"dispatch={dispatch!r}: use 'auto', 'single', or 'round_robin'"
             )
-        self._pipeline_depth = PIPELINE_DEPTH
         rr_devices = None
+        local = list(devices) if devices is not None else jax.local_devices()
+        platform = local[0].platform if local else "cpu"
         if mesh is None and dispatch != "single":
-            devs = list(devices) if devices is not None else jax.local_devices()
             # 'auto' opts in only on real multi-accelerator hosts; the CPU
             # backend's virtual devices share one memory bus, so multi-stream
             # dispatch there only adds copies (tests opt in explicitly)
-            if len(devs) > 1 and (
-                dispatch == "round_robin" or devs[0].platform not in ("cpu",)
+            if len(local) > 1 and (
+                dispatch == "round_robin" or platform not in ("cpu",)
             ):
-                rr_devices = devs
+                rr_devices = local
 
         if mesh is not None:
             inner = sharded_match_fn(match_fn, mesh, rows_multiple=rows_mult)
             dp = inner.data_parallelism
-            self._match = lambda b: inner(pad_batch(b, dp))
+            self._match = single_stream_match_fn(
+                lambda b: inner(pad_batch(b, dp))
+            )
             row_multiple = dp
         elif rr_devices is not None:
             self._match = round_robin_match_fn(
                 match_fn, rr_devices, rows_multiple=rows_mult
             )
             row_multiple = rows_mult
-            # keep every transfer stream busy: at least one batch in flight
-            # per device plus the usual dispatch-ahead margin
-            self._pipeline_depth = PIPELINE_DEPTH + len(rr_devices) - 1
         elif rows_mult > 1:
-            self._match = lambda b: match_fn(pad_batch(b, rows_mult))
+            self._match = single_stream_match_fn(
+                lambda b: match_fn(pad_batch(b, rows_mult))
+            )
             row_multiple = rows_mult
         else:
-            self._match = match_fn
+            self._match = single_stream_match_fn(match_fn)
             row_multiple = 1
+
+        # transfer-stream sizing: one worker thread per round-robin device
+        # (per-device copies overlap each other), several streams on one
+        # accelerator (concurrent device_puts are the only way past a
+        # serialized tunnel link), two on the CPU backend (keeps the async
+        # machinery exercised in tests without thrashing one memory bus)
+        if feed_streams <= 0:
+            feed_streams = int(
+                os.environ.get("TRIVY_TPU_FEED_STREAMS", "0") or 0
+            )
+        if feed_streams <= 0:
+            if rr_devices is not None:
+                feed_streams = len(rr_devices)
+            elif platform in ("cpu", "METAL"):
+                feed_streams = 2
+            else:
+                feed_streams = SINGLE_DEVICE_STREAMS
+        self.feed_streams = max(1, feed_streams)
+        if inflight <= 0:
+            inflight = int(
+                os.environ.get("TRIVY_TPU_FEED_INFLIGHT", "0") or 0
+            )
+        self.inflight = max(1, inflight or FEED_INFLIGHT)
         # dispatch-shape bucket ladder: every shape compiles exactly once
         # (variable trailing-batch shapes would recompile per distinct size).
         # The ladder stops at B/4: each extra rung costs a full Mosaic
@@ -363,500 +433,36 @@ class TpuSecretScanner:
                 self._persist_key(key), {"r": list(hit_rules)}
             )
 
-    # -- core batching loop -------------------------------------------------
-
-    def _device_loop(self, in_q, out_q, ctx) -> None:
-        """Single device thread: dispatch batches asynchronously, defer the
-        blocking result fetch until the pipeline is full.
-
-        One thread does BOTH dispatch and fetch on purpose: jax dispatch is
-        async, so batch N+1's host→device transfer proceeds while batch N's
-        kernel runs — full overlap from one thread — and keeping dispatch
-        and fetch off separate threads matters under the axon tunnel, whose
-        transfer journal only reclaims per-transfer buffers when transfers
-        and fetches don't interleave across threads (measured: the
-        two-thread pipeline retains ~0.9 byte/byte scanned; this loop with
-        identical depth is flat).
-
-        Stall instrumentation (all on ``ctx``, the spawning scan's trace
-        context — this thread outlives the contextvar scope):
-        ``secret.feed_wait`` is time blocked on the host feed (feed-starved),
-        ``secret.dispatch`` the enqueue/transfer handoff (upload-bound),
-        ``secret.device_wait`` the blocking result fetch (device-bound).
-
-        Failure domain (the per-batch rung of the ladder): a failed
-        dispatch or fetch re-dispatches that batch up to ``batch_retries``
-        times — under round-robin dispatch the retry lands on the next
-        healthy device, and the breaker's failure/success feedback is
-        recorded here. OOM-shaped errors split the batch in half instead
-        of retrying it whole (halving terminates on its own, so splits
-        don't consume the retry budget). Only when the ladder is exhausted
-        — or every device is circuit-broken — does the failure escalate to
-        ``scan_files``'s host fallback.
-        """
-        from trivy_tpu.parallel.mesh import DevicesUnavailable
-
-        pending: deque = deque()  # (dev, meta, batch, device_idx, retries)
-        match = self._match
-        dispatch_fn = getattr(match, "dispatch", None)
-        record = getattr(match, "record_result", None)
-        stats = self.stats
-        chunk_len = self.chunk_len
-        prof = ctx.profile() if ctx.enabled else None
-
-        def rebatch(batch: np.ndarray, meta: list) -> np.ndarray:
-            """Fresh bucket-padded copy of a failed batch's live rows — the
-            original may be a ring-buffer view whose slot the feeder is
-            about to refill, so retries never alias it."""
-            n = next(b for b in self._buckets if b >= len(meta))
-            out = np.zeros((n, chunk_len), dtype=np.uint8)
-            out[: len(meta)] = batch[: len(meta)]
-            return out
-
-        def recover(batch, meta, retries, err) -> list:
-            """Ladder decision for one failed batch: work items to
-            re-dispatch, or raise when the ladder is exhausted."""
-            if isinstance(err, DevicesUnavailable):
-                raise err  # no device left to retry on
-            if _is_oom(err) and len(meta) > 1:
-                stats.add(batch_splits=1)
-                ctx.count("secret.batch_splits")
-                logger.warning(
-                    "device OOM on a %d-row batch (%s); splitting and "
-                    "re-dispatching the halves", len(meta), err,
-                )
-                mid = (len(meta) + 1) // 2
-                return [
-                    (rebatch(batch[:mid], meta[:mid]), meta[:mid], retries),
-                    (rebatch(batch[mid:], meta[mid:]), meta[mid:], retries),
-                ]
-            if retries < self._batch_retries:
-                stats.add(batch_retries=1)
-                ctx.count("secret.batch_retries")
-                logger.warning(
-                    "device error on a %d-row batch (retry %d/%d): %s",
-                    len(meta), retries + 1, self._batch_retries, err,
-                )
-                return [(rebatch(batch, meta), meta, retries + 1)]
-            raise err
-
-        def dispatch_batch(batch, meta, retries) -> None:
-            work = [(batch, meta, retries)]
-            while work:
-                b, m, r = work.pop()
-                try:
-                    with ctx.span("secret.dispatch"):
-                        if dispatch_fn is not None:
-                            dev, didx = dispatch_fn(b)
-                        else:
-                            faults.check("device.dispatch", key="d0")
-                            dev, didx = match(b), None
-                except Exception as e:
-                    # dispatch-time failure (breaker already notified by
-                    # the round-robin wrapper); walk the ladder
-                    work.extend(recover(b, m, r, e))
-                    continue
-                pending.append((dev, m, b, didx, r))
-
-        def fetch_oldest():
-            dev, meta, batch, didx, retries = pending.popleft()
-            try:
-                faults.check(
-                    "device.fetch", key=f"d{didx if didx is not None else 0}"
-                )
-                t0 = time.perf_counter()
-                with ctx.span("secret.device_wait"):
-                    arr = np.asarray(dev)
-                if prof is not None:
-                    # per-bucket dispatch cost: the bucket is the padded
-                    # batch shape (the compile-once ladder rung), rows are
-                    # the live rows it carried
-                    prof.bucket_dispatch(
-                        batch.shape[0], len(meta), time.perf_counter() - t0
-                    )
-            except Exception as e:
-                if record is not None and didx is not None:
-                    record(didx, False)
-                for item in recover(batch, meta, retries, e):
-                    dispatch_batch(*item)
-                return
-            if record is not None and didx is not None:
-                record(didx, True)
-            out_q.put((arr, meta))
-
-        with obs.activate(ctx):
-            try:
-                while True:
-                    with ctx.span("secret.feed_wait"):
-                        item = in_q.get()
-                    if item is None:
-                        break
-                    batch, meta = item
-                    dispatch_batch(batch, meta, 0)
-                    if len(pending) >= self._pipeline_depth:
-                        fetch_oldest()
-                while pending:
-                    fetch_oldest()
-            except BaseException as e:  # retry ladder exhausted: surface it
-                # the feeder sees the exception on its next drain and raises;
-                # empty the queue first so a feeder blocked on a full in_q
-                # wakes up (its batches are lost — either the scan is failing
-                # or the host fallback rescans every unresolved file anyway)
-                while True:
-                    try:
-                        in_q.get_nowait()
-                    except queue.Empty:
-                        break
-                out_q.put(_DeviceFailed(e) if isinstance(e, Exception) else e)
-                return
-            out_q.put(None)
+    # -- async feed pipeline ------------------------------------------------
 
     def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
-        """Scan many files; yields per-file results in input order."""
-        # order-preserving result store; files resolve once all chunks
-        # matched; values are Secrets or in-flight confirmation Futures
-        results: dict[int, Secret | Future] = {}
-        states: dict[int, _FileState] = {}
-        next_emit = 0
-        total = 0
-        stats = self.stats
-        # capture the caller's trace context once: the device thread and
-        # confirm pool record into it via obs.activate (worker threads do
-        # not inherit the contextvar)
-        ctx = obs.current()
-        # per-rule cost profile (gate hits here; confirm timing in the
-        # confirm pool); same enabled gate as spans
-        prof = ctx.profile() if ctx.enabled else None
-        rule_ids = self.compiled.rule_ids
-        chunk_len = self.chunk_len
-        dedup = self._dedup
-        fp_key = self.ruleset_fingerprint
-        # row digest -> waiting segment lists: identical rows already
-        # dispatched but not yet resolved are coalesced here instead of
-        # being uploaded again (zero pages recur within a single batch)
-        inflight: dict[bytes, list[list[tuple[int, int, int]]]] = {}
+        """Scan many files; yields per-file results in input order.
 
-        # ring of host batch buffers sized for every stage a batch can be
-        # in at once: queued to the device thread (pipeline depth), being
-        # dispatched (1), dispatched-but-unfetched (pipeline depth, matters
-        # on the CPU backend where jax may alias the numpy buffer
-        # zero-copy), plus the one being packed — refilling a ring slot
-        # can then never touch a batch still in any of those stages
-        bufs = [
-            np.zeros((self.batch_size, chunk_len), dtype=np.uint8)
-            for _ in range(2 * self._pipeline_depth + 2)
-        ]
-        buf_i = 0
-        buf = bufs[0]
-        # per-row feed metadata: (digest | None, [(fidx, win_start, win_end)])
-        meta: list[tuple[bytes | None, list[tuple[int, int, int]]]] = []
-        pool = ThreadPoolExecutor(max_workers=self.confirm_workers)
-        # the single device thread (see _device_loop); in_q's bound is the
-        # feeder backpressure, out_q carries fetched hit matrices back
-        in_q: queue.Queue = queue.Queue(maxsize=self._pipeline_depth)
-        out_q: queue.Queue = queue.Queue()
-        device_thread = threading.Thread(
-            target=self._device_loop, args=(in_q, out_q, ctx), daemon=True
-        )
-        device_thread.start()
-        # backpressure: bounds queued+running confirms so a slow confirm
-        # pool cannot accumulate unbounded _FileState.data on a large
-        # streaming scan (file bytes are released once its confirm runs)
-        confirm_slots = threading.Semaphore(self.confirm_workers * 4)
-
-        def confirm_task(st: _FileState) -> Secret:
-            try:
-                with obs.activate(ctx), ctx.span("secret.confirm"):
-                    return self._confirm(st, prof)
-            finally:
-                confirm_slots.release()
-
-        def apply_hits(
-            segs: list[tuple[int, int, int]], hit_rules: tuple[int, ...]
-        ) -> None:
-            """Credit one resolved row to its file segments: record candidate
-            windows (every row hit applies to every segment — cross-segment
-            false candidates are discarded by the exact confirm), then
-            retire each segment's pending count."""
-            if prof is not None and hit_rules:
-                # one logical device hit per (row, rule) — dedup-cache and
-                # coalesced rows count too: they cost a confirm all the same
-                for r in hit_rules:
-                    prof.gate_hit(rule_ids[r])
-            for fidx, ws, we in segs:
-                st = states[fidx]
-                for r in hit_rules:
-                    st.rules.setdefault(r, []).append((ws, we))
-            for fidx, _, _ in segs:
-                st = states[fidx]
-                st.pending -= 1
-                if st.pending == 0:
-                    confirm_slots.acquire()
-                    results[fidx] = pool.submit(confirm_task, st)
-                    del states[fidx]
-
-        def resolve(batch_hits: np.ndarray, batch_meta: list) -> None:
-            # one vectorized nonzero per batch, not one per row
-            rows, ridx = np.nonzero(batch_hits[: len(batch_meta)])
-            by_row: dict[int, list[int]] = {}
-            for row, r in zip(rows.tolist(), ridx.tolist()):
-                by_row.setdefault(row, []).append(r)
-            for row, (key, segs) in enumerate(batch_meta):
-                hit_rules = tuple(by_row.get(row, ()))
-                apply_hits(segs, hit_rules)
-                if key is not None:
-                    self._hit_put(key, hit_rules)
-                    for waiting in inflight.pop(key, ()):
-                        apply_hits(waiting, hit_rules)
-
-        def drain_results(block: bool = False) -> bool:
-            """Resolve fetched batches; returns False once the device
-            thread signalled completion; re-raises a device failure."""
-            while True:
-                try:
-                    item = out_q.get(block=block)
-                except queue.Empty:
-                    return True
-                if item is None:
-                    return False
-                if isinstance(item, BaseException):
-                    raise item
-                resolve(*item)
-                block = False
-
-        def flush():
-            nonlocal meta, buf, buf_i
-            if not meta:
-                return
-            n = next(b for b in self._buckets if b >= len(meta))
-            stats.add(bytes_uploaded=n * chunk_len)
-            ctx.count("secret.bytes_uploaded", n * chunk_len)
-            ctx.sample("secret.queue_depth", in_q.qsize())
-            in_q.put((buf[:n], meta))
-            meta = []
-            # rotate to the next ring buffer; full rows are overwritten on
-            # fill and partial rows zero their own tails (stale rows past
-            # len(meta) are sliced off in resolve), so no re-zeroing of the
-            # whole batch is needed
-            buf_i = (buf_i + 1) % len(bufs)
-            buf = bufs[buf_i]
-            drain_results()
-            # bound pack-row staleness to one batch: a lone small file must
-            # not sit in pack_pending while big files stream past it — its
-            # unresolved state would stall in-order emission and let results
-            # accumulate unbounded on a streaming scan. The partial pack row
-            # rides the next batch instead (re-entry is shallow: the fresh
-            # meta holds one row, far below batch_size, so no second flush)
-            if pack_pending:
-                emit_pack()
-
-        def feed_row(
-            key: bytes | None,
-            segs: list[tuple[int, int, int]],
-            parts: list[tuple[int, np.ndarray]],
-            nbytes: int,
-            packed: bool,
-        ) -> None:
-            """Resolve a row from the hit cache, coalesce onto an identical
-            in-flight row, or pack it into the current batch buffer."""
-            stats.add(chunks=1)
-            if key is not None:
-                cached = self._hit_get(key)
-                if cached is not None:
-                    stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
-                    ctx.count("secret.bytes_dedup_hit", nbytes)
-                    apply_hits(segs, cached)
-                    return
-                waiting = inflight.get(key)
-                if waiting is not None:
-                    waiting.append(segs)
-                    stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
-                    ctx.count("secret.bytes_dedup_hit", nbytes)
-                    return
-                inflight[key] = []
-            row = buf[len(meta)]
-            if packed:
-                row[:] = 0  # zero guard gaps + tail (ring rows hold stale data)
-                for off, piece in parts:
-                    row[off : off + len(piece)] = piece
-                if len(segs) > 1:
-                    stats.add(
-                        rows_packed=1, files_packed=len(segs), bytes_packed=nbytes
-                    )
-                    ctx.count("secret.bytes_packed", nbytes)
-            else:
-                piece = parts[0][1]
-                row[: len(piece)] = piece
-                if len(piece) < chunk_len:
-                    row[len(piece):] = 0  # clear stale tail
-            stats.add(chunks_uploaded=1)
-            meta.append((key, segs))
-            if len(meta) == self.batch_size:
-                flush()
-
-        # small-file packing: files below a row's size accumulate here and
-        # share one row, separated by >=span zero gaps (see module docstring
-        # for why packing cannot suppress a real match)
-        gap = self.overlap
-        pack_max = chunk_len - gap
-        pack_pending: list[tuple[int, bytes]] = []
-        pack_len = 0
-
-        def emit_pack() -> None:
-            nonlocal pack_len
-            if not pack_pending:
-                return
-            items = list(pack_pending)
-            pack_pending.clear()
-            pack_len = 0
-            key = None
-            if dedup:
-                if len(items) == 1:
-                    # single-segment row == plain chunk-row layout: share the
-                    # plain digest domain so it dedups across both paths
-                    key = hashlib.blake2b(
-                        items[0][1], digest_size=16, key=fp_key
-                    ).digest()
-                else:
-                    h = hashlib.blake2b(
-                        digest_size=16, key=fp_key, person=b"packed-row"
-                    )
-                    for _, d in items:
-                        h.update(len(d).to_bytes(4, "little"))
-                        h.update(d)
-                    key = h.digest()
-            segs = []
-            parts = []
-            off = 0
-            for fidx, d in items:
-                segs.append((fidx, 0, len(d)))
-                parts.append((off, np.frombuffer(d, dtype=np.uint8)))
-                off += len(d) + gap
-            feed_row(key, segs, parts, sum(len(d) for _, d in items), True)
-
-        def add_small(fidx: int, data: bytes) -> None:
-            nonlocal pack_len
-            if pack_len and pack_len + gap + len(data) > chunk_len:
-                emit_pack()
-            pack_pending.append((fidx, data))
-            pack_len += (gap if pack_len else 0) + len(data)
-
-        def drain() -> None:
-            in_q.put(None)
-            while drain_results(block=True):
-                pass
-            device_thread.join()
-
-        def host_task(path: str, data: bytes) -> Secret:
-            # degraded-mode rung: the exact host engine IS the parity
-            # oracle, so fallback findings are byte-identical by definition
-            try:
-                with obs.activate(ctx), ctx.span("secret.host_fallback"):
-                    return self.exact.scan_bytes(path, data)
-            finally:
-                confirm_slots.release()
-
-        files_it = enumerate(files)
+        The input iterable is consumed on a dedicated feeder thread, so a
+        slow consumer of this generator (or a slow head-of-line
+        confirmation) never stalls chunking, hashing, or device transfers
+        — backpressure comes only from the bounded arena, dispatch queue,
+        and confirm semaphore. See :class:`_ScanRun` for the pipeline.
+        """
+        run = _ScanRun(self, files, obs.current())
+        run.start()
         try:
-            try:
-                for fidx, (path, data) in files_it:
-                    total += 1
-                    # path-level global allowlist: skip the whole file (ref:
-                    # scanner.go:388-392) — no device work either
-                    if self.exact.allow_path(path):
-                        results[fidx] = Secret(file_path=path)
-                    elif not data:
-                        # empty file: nothing for the device to match —
-                        # resolve host-side immediately (host-lane rules
-                        # still run there)
-                        st = _FileState(path=path, data=data, pending=0)
-                        confirm_slots.acquire()
-                        results[fidx] = pool.submit(confirm_task, st)
-                    else:
-                        stats.add(bytes_in=len(data))
-                        if self._pack_small and len(data) <= pack_max:
-                            states[fidx] = _FileState(
-                                path=path, data=data, pending=1
-                            )
-                            add_small(fidx, data)
-                        else:
-                            starts = chunk_spans(
-                                len(data), chunk_len, self.overlap
-                            )
-                            states[fidx] = _FileState(
-                                path=path, data=data, pending=len(starts)
-                            )
-                            arr = np.frombuffer(data, dtype=np.uint8)
-                            for s in starts:
-                                piece = arr[s : s + chunk_len]
-                                key = (
-                                    hashlib.blake2b(
-                                        piece, digest_size=16, key=fp_key
-                                    ).digest()
-                                    if dedup
-                                    else None
-                                )
-                                feed_row(
-                                    key,
-                                    [(fidx, s, s + chunk_len)],
-                                    [(0, piece)],
-                                    len(piece),
-                                    False,
-                                )
-                    # emit in order as soon as the contiguous prefix is done;
-                    # block on a confirmation only when it is next in line
-                    while next_emit in results:
-                        r = results.pop(next_emit)
-                        yield r.result() if isinstance(r, Future) else r
-                        next_emit += 1
-                emit_pack()  # flush the partial pack row
-                flush()  # dispatch the final partial batch
-                drain()  # resolve whatever is still in flight
-            except _DeviceFailed as e:
-                # the device loop's retry ladder is exhausted (or every
-                # device is circuit-broken): last rung — finish the scan on
-                # the exact host path instead of failing it
-                if not self._host_fallback:
-                    raise e.cause from None
-                self._note_degraded(ctx, e.cause)
-                inflight.clear()
-                pack_pending.clear()
-                # every file with unresolved device work rescans host-side
-                # (partial device results for it are discarded); already-
-                # submitted confirms keep completing on the same pool
-                for fidx in sorted(states):
-                    st = states.pop(fidx)
-                    confirm_slots.acquire()
-                    results[fidx] = pool.submit(host_task, st.path, st.data)
-                # files not yet pulled from the input stream go straight to
-                # the host path, same backpressure bound
-                for fidx, (path, data) in files_it:
-                    total += 1
-                    confirm_slots.acquire()
-                    results[fidx] = pool.submit(host_task, path, data)
-                    while next_emit in results:
-                        r = results.pop(next_emit)
-                        yield r.result() if isinstance(r, Future) else r
-                        next_emit += 1
-            while next_emit < total:
-                r = results.pop(next_emit)
+            next_emit = 0
+            while True:
+                with run.cond:
+                    while True:
+                        if run.error is not None:
+                            raise run.error
+                        if next_emit in run.results:
+                            r = run.results.pop(next_emit)
+                            break
+                        if run.total is not None and next_emit >= run.total:
+                            return
+                        run.cond.wait(0.2)
                 yield r.result() if isinstance(r, Future) else r
                 next_emit += 1
         finally:
-            pool.shutdown(wait=False)
-            if device_thread.is_alive():
-                # generator closed early: make room if the queue is full,
-                # then deliver the shutdown sentinel (dropping it would
-                # leave the device thread blocked on in_q.get() forever)
-                while True:
-                    try:
-                        in_q.put_nowait(None)
-                        break
-                    except queue.Full:
-                        try:
-                            in_q.get_nowait()
-                        except queue.Empty:
-                            pass
+            run.close()
 
     def scan_bytes(self, path: str, data: bytes) -> Secret:
         """Single-file convenience (still device-prefiltered)."""
@@ -914,3 +520,714 @@ class TpuSecretScanner:
                 prof.confirm(rule.id, time.perf_counter() - t0, len(locs))
             hits.extend((rule, loc) for loc in locs)
         return self.exact.build_findings(st.path, content, hits)
+
+
+# sentinel a worker receives when the pipeline is shutting down or has
+# switched to the host fallback (distinct from the end-of-input None)
+_ABORT = object()
+
+
+class _ScanRun:
+    """One ``scan_files`` invocation's async pipeline.
+
+    Threads (all daemon, all scoped to this run):
+
+    - **feeder**: consumes the caller's file iterable; chunks, hashes
+      (dedup keys), packs small files, and assembles rows into
+      :class:`~trivy_tpu.secret.feed.ChunkArena` slabs — large files via
+      one vectorized strided gather per slab run, not per-row Python —
+      then hands full slabs to the bounded dispatch queue.
+    - **transfer streams** (``scanner.feed_streams`` workers): each pulls
+      slabs, dispatches through ``scanner._match.dispatch`` (round-robin
+      across devices or concurrent streams into one device), keeps a
+      bounded in-flight window (double buffering: transfer N+1 overlaps
+      kernel N), fetches the oldest result, releases the slab, and
+      resolves hits inline. The per-batch retry ladder (re-dispatch,
+      OOM halving, circuit-breaker feedback) runs here, per stream.
+    - **confirm pool**: exact host confirmation, bounded by a semaphore
+      so retained file bytes stay flat on streaming scans.
+
+    The generator side of ``scan_files`` only emits: completed results
+    land in ``results`` (the reorder buffer) keyed by input index and are
+    yielded in order. Emission never blocks the feeder.
+
+    Failure ladder: a stream that exhausts its retries calls
+    :meth:`_degrade` (host fallback: every unresolved and unread file is
+    rescanned by the exact host engine — the parity oracle) or, with
+    ``host_fallback=False``, :meth:`_fail` so the generator re-raises.
+    """
+
+    def __init__(self, sc: TpuSecretScanner, files, ctx):
+        self.sc = sc
+        self.files = files
+        self.ctx = ctx
+        self.enabled = ctx.enabled
+        self.prof = ctx.profile() if ctx.enabled else None
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.states: dict[int, _FileState] = {}
+        # reorder buffer: input index -> Secret | in-flight Future
+        self.results: dict[int, Secret | Future] = {}
+        # row digest -> waiting segment lists: identical rows already
+        # dispatched but not yet resolved coalesce here instead of being
+        # uploaded again (zero pages recur within a single batch)
+        self.row_waiters: dict[bytes, list] = {}
+        self.total: int | None = None  # set once the input is exhausted
+        self.error: BaseException | None = None
+        self.degraded = False
+        self.stop = threading.Event()
+        streams = sc.feed_streams
+        self.in_q: queue.Queue = queue.Queue(maxsize=FEED_QUEUE_DEPTH)
+        self.arena = ChunkArena(
+            FEED_QUEUE_DEPTH + streams * sc.inflight + ARENA_MARGIN,
+            sc.batch_size,
+            sc.chunk_len,
+        )
+        self.pool = ThreadPoolExecutor(max_workers=sc.confirm_workers)
+        # backpressure: bounds queued+running confirms so a slow confirm
+        # pool cannot accumulate unbounded _FileState.data on a large
+        # streaming scan (file bytes are released once its confirm runs)
+        self.confirm_slots = threading.Semaphore(sc.confirm_workers * 4)
+        self.workers = [
+            threading.Thread(
+                target=self._worker, args=(i,), daemon=True,
+                name=f"secret-xfer-{i}",
+            )
+            for i in range(streams)
+        ]
+        self.feeder = threading.Thread(
+            target=self._feed_guarded, daemon=True, name="secret-feeder"
+        )
+
+    def start(self) -> None:
+        for w in self.workers:
+            w.start()
+        self.feeder.start()
+
+    def close(self) -> None:
+        self.stop.set()
+        self.feeder.join(timeout=10.0)
+        for w in self.workers:
+            w.join(timeout=10.0)
+        self.pool.shutdown(wait=False)
+        # slabs still parked in the dispatch queue after an early close
+        while True:
+            try:
+                item = self.in_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None and item is not _ABORT:
+                self.arena.release(item[0])
+        # feed-path introspection for tests and bench debugging: on a
+        # clean scan every slab is back in the arena (no leak into the
+        # streaming-RSS budget) and acquires ≫ slabs proves reuse
+        self.sc._last_feed_stats = {
+            "arena_slabs": self.arena.n_slabs,
+            "arena_free": self.arena.free_slabs,
+            "arena_acquires": self.arena.acquires,
+            "streams": len(self.workers),
+        }
+
+    # -- shared control -----------------------------------------------------
+
+    def _aborted(self) -> bool:
+        return (
+            self.stop.is_set() or self.error is not None or self.degraded
+        )
+
+    def _put_slab(self, item) -> bool:
+        while not self._aborted():
+            try:
+                self.in_q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _put_sentinel(self) -> None:
+        while not self._aborted():
+            try:
+                self.in_q.put(None, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _get_work(self):
+        while True:
+            if self._aborted():
+                return _ABORT
+            try:
+                return self.in_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+
+    def _fail(self, err: BaseException) -> None:
+        with self.cond:
+            if self.error is None:
+                self.error = err
+            self.cond.notify_all()
+        self.stop.set()
+
+    def _degrade(self, cause: BaseException) -> None:
+        """Last rung: move every file with unresolved device work onto the
+        exact host confirm path (partial device results are discarded),
+        once. The feeder notices ``degraded`` and routes the rest of the
+        input stream straight to the host engine."""
+        with self.lock:
+            if self.degraded or self.error is not None:
+                return
+            self.degraded = True
+            moved = [(i, self.states.pop(i)) for i in sorted(self.states)]
+            self.row_waiters.clear()
+        self.sc._note_degraded(self.ctx, cause)
+        for fidx, st in moved:
+            self._submit_host(fidx, st.path, st.data)
+        with self.cond:
+            self.cond.notify_all()
+
+    # -- result plumbing ----------------------------------------------------
+
+    def _acquire_slot(self) -> bool:
+        while not (self.stop.is_set() or self.error is not None):
+            if self.confirm_slots.acquire(timeout=0.2):
+                return True
+        return False
+
+    def _set_result(self, fidx: int, value) -> None:
+        with self.cond:
+            self.results[fidx] = value
+            self.cond.notify_all()
+
+    def _confirm_task(self, st: _FileState) -> Secret:
+        try:
+            with obs.activate(self.ctx), self.ctx.span("secret.confirm"):
+                return self.sc._confirm(st, self.prof)
+        finally:
+            self.confirm_slots.release()
+
+    def _host_task(self, path: str, data: bytes) -> Secret:
+        # degraded-mode rung: the exact host engine IS the parity oracle,
+        # so fallback findings are byte-identical by definition
+        try:
+            with obs.activate(self.ctx), self.ctx.span("secret.host_fallback"):
+                return self.sc.exact.scan_bytes(path, data)
+        finally:
+            self.confirm_slots.release()
+
+    def _submit_confirm(self, fidx: int, st: _FileState) -> None:
+        if not self._acquire_slot():
+            return  # shutting down; nobody will wait on this result
+        self._set_result(fidx, self.pool.submit(self._confirm_task, st))
+
+    def _submit_host(self, fidx: int, path: str, data: bytes) -> None:
+        if not self._acquire_slot():
+            return
+        self._set_result(fidx, self.pool.submit(self._host_task, path, data))
+
+    def _apply_hits(self, batch: list) -> None:
+        """Credit resolved rows to their file segments; ``batch`` is
+        ``[(segs, hit_rules)]``. Every row hit applies to every segment —
+        cross-segment false candidates are discarded by the exact confirm.
+        Files whose last pending row resolved here go to the confirm pool
+        (the semaphore is taken OUTSIDE the pipeline lock so a full
+        confirm queue stalls only the calling thread, not resolution
+        bookkeeping on other streams)."""
+        prof = self.prof
+        if prof is not None:
+            rule_ids = self.sc.compiled.rule_ids
+            for _, hit_rules in batch:
+                # one logical device hit per (row, rule) — dedup-cache and
+                # coalesced rows count too: they cost a confirm all the same
+                for r in hit_rules:
+                    prof.gate_hit(rule_ids[r])
+        ready: list[tuple[int, _FileState]] = []
+        with self.lock:
+            for segs, hit_rules in batch:
+                for fidx, ws, we in segs:
+                    st = self.states.get(fidx)
+                    if st is None:
+                        continue  # already moved to the host path
+                    for r in hit_rules:
+                        st.rules.setdefault(r, []).append((ws, we))
+                for fidx, _, _ in segs:
+                    st = self.states.get(fidx)
+                    if st is None:
+                        continue
+                    st.pending -= 1
+                    if st.pending == 0:
+                        del self.states[fidx]
+                        ready.append((fidx, st))
+        for fidx, st in ready:
+            self._submit_confirm(fidx, st)
+
+    def _resolve(self, batch_hits: np.ndarray, batch_meta: list) -> None:
+        # one vectorized nonzero per batch, not one per row; rows past
+        # len(batch_meta) are bucket padding and are sliced off here
+        rows, ridx = np.nonzero(batch_hits[: len(batch_meta)])
+        by_row: dict[int, list[int]] = {}
+        for row, r in zip(rows.tolist(), ridx.tolist()):
+            by_row.setdefault(row, []).append(r)
+        apply: list = []
+        for row, (key, segs) in enumerate(batch_meta):
+            hit_rules = tuple(by_row.get(row, ()))
+            apply.append((segs, hit_rules))
+            if key is not None:
+                self.sc._hit_put(key, hit_rules)
+                with self.lock:
+                    waiting = self.row_waiters.pop(key, ())
+                for w in waiting:
+                    apply.append((w, hit_rules))
+        self._apply_hits(apply)
+
+    # -- transfer-stream workers --------------------------------------------
+
+    def _worker(self, wid: int) -> None:
+        """One transfer stream: dispatch slabs asynchronously, keep a
+        bounded in-flight window (double buffering), fetch the oldest,
+        resolve inline. Per-batch failure ladder as in README
+        "Robustness": re-dispatch up to ``batch_retries`` times (under
+        round-robin the retry lands on the next healthy device and the
+        breaker hears about it), OOM-shaped errors split the batch in
+        half, and only an exhausted ladder (or every device
+        circuit-broken) escalates to the scan-level host fallback.
+
+        Stall instrumentation (all on the spawning scan's context):
+        ``secret.feed_wait`` is time blocked on the host feed
+        (feed-starved), ``secret.dispatch`` the enqueue/transfer handoff
+        (upload-bound), ``secret.device_wait`` the blocking result fetch
+        (device-bound)."""
+        from trivy_tpu.parallel.mesh import DevicesUnavailable
+
+        sc = self.sc
+        ctx = self.ctx
+        match = sc._match
+        dispatch_fn = match.dispatch
+        record = getattr(match, "record_result", None)
+        prof = self.prof
+        stats = sc.stats
+        chunk_len = sc.chunk_len
+        # (dev, meta, batch, slab_id, device_idx, retries); slab_id is None
+        # for retry copies, which own their arrays outright
+        pending: deque = deque()
+
+        def rebatch(batch: np.ndarray, meta: list) -> np.ndarray:
+            """Fresh bucket-padded copy of a failed batch's live rows —
+            the source slab is released right after, so retries never
+            alias arena memory the feeder may refill."""
+            n = next(b for b in sc._buckets if b >= len(meta))
+            out = np.zeros((n, chunk_len), dtype=np.uint8)
+            out[: len(meta)] = batch[: len(meta)]
+            return out
+
+        def recover(batch, meta, slab_id, retries, err) -> list:
+            """Ladder decision for one failed batch: work items to
+            re-dispatch, or raise when the ladder is exhausted. Always
+            ends the source slab's ownership."""
+            if isinstance(err, DevicesUnavailable):
+                if slab_id is not None:
+                    self.arena.release(slab_id)
+                raise _DeviceFailed(err)  # no device left to retry on
+            if _is_oom(err) and len(meta) > 1:
+                stats.add(batch_splits=1)
+                if self.enabled:
+                    ctx.count("secret.batch_splits")
+                logger.warning(
+                    "device OOM on a %d-row batch (%s); splitting and "
+                    "re-dispatching the halves", len(meta), err,
+                )
+                mid = (len(meta) + 1) // 2
+                halves = [
+                    (rebatch(batch[:mid], meta[:mid]), meta[:mid], None, retries),
+                    (rebatch(batch[mid:], meta[mid:]), meta[mid:], None, retries),
+                ]
+                if slab_id is not None:
+                    self.arena.release(slab_id)
+                return halves
+            if retries < sc._batch_retries:
+                stats.add(batch_retries=1)
+                if self.enabled:
+                    ctx.count("secret.batch_retries")
+                logger.warning(
+                    "device error on a %d-row batch (retry %d/%d): %s",
+                    len(meta), retries + 1, sc._batch_retries, err,
+                )
+                fresh = rebatch(batch, meta)
+                if slab_id is not None:
+                    self.arena.release(slab_id)
+                return [(fresh, meta, None, retries + 1)]
+            if slab_id is not None:
+                self.arena.release(slab_id)
+            raise _DeviceFailed(err)
+
+        def dispatch_batch(batch, meta, slab_id, retries) -> None:
+            work = [(batch, meta, slab_id, retries)]
+            while work:
+                b, m, sid, r = work.pop()
+                try:
+                    with ctx.span("secret.dispatch"):
+                        dev, didx = dispatch_fn(b)
+                except Exception as e:
+                    # dispatch-time failure (breaker already notified by
+                    # the round-robin wrapper); walk the ladder
+                    work.extend(recover(b, m, sid, r, e))
+                    continue
+                pending.append((dev, m, b, sid, didx, r))
+
+        def fetch_oldest() -> None:
+            dev, meta, batch, sid, didx, retries = pending.popleft()
+            try:
+                faults.check(
+                    "device.fetch", key=f"d{didx if didx is not None else 0}"
+                )
+                t0 = time.perf_counter() if prof is not None else 0.0
+                with ctx.span("secret.device_wait"):
+                    arr = np.asarray(dev)
+                if prof is not None:
+                    # per-bucket dispatch cost: the bucket is the padded
+                    # batch shape (the compile-once ladder rung), rows are
+                    # the live rows it carried
+                    prof.bucket_dispatch(
+                        batch.shape[0], len(meta), time.perf_counter() - t0
+                    )
+            except Exception as e:
+                if record is not None and didx is not None:
+                    record(didx, False)
+                for item in recover(batch, meta, sid, retries, e):
+                    dispatch_batch(*item)
+                return
+            if record is not None and didx is not None:
+                record(didx, True)
+            if sid is not None:
+                # the fetch proves the transfer finished: the slab can be
+                # refilled without aliasing a zero-copy device view
+                self.arena.release(sid)
+            if not self.degraded:
+                self._resolve(arr, meta)
+
+        def release_pending() -> None:
+            while pending:
+                _, _, _, sid, _, _ = pending.popleft()
+                if sid is not None:
+                    self.arena.release(sid)
+
+        with obs.activate(ctx):
+            try:
+                while True:
+                    with ctx.span("secret.feed_wait"):
+                        item = self._get_work()
+                    if item is None or item is _ABORT:
+                        break
+                    slab_id, batch, meta = item
+                    dispatch_batch(batch, meta, slab_id, 0)
+                    while len(pending) >= sc.inflight:
+                        fetch_oldest()
+                while pending and not self._aborted():
+                    fetch_oldest()
+            except _DeviceFailed as e:
+                release_pending()
+                if sc._host_fallback:
+                    self._degrade(e.cause)
+                else:
+                    self._fail(e.cause)
+            except BaseException as e:  # unexpected: surface it loudly
+                release_pending()
+                self._fail(e)
+            finally:
+                release_pending()
+                if self.degraded:
+                    # return whatever the feeder parked before it noticed
+                    while True:
+                        try:
+                            item = self.in_q.get_nowait()
+                        except queue.Empty:
+                            break
+                        if item is not None and item is not _ABORT:
+                            self.arena.release(item[0])
+
+    # -- feeder -------------------------------------------------------------
+
+    def _feed_guarded(self) -> None:
+        with obs.activate(self.ctx):
+            try:
+                self._feed()
+            except BaseException as e:
+                self._fail(e)
+
+    def _feed(self) -> None:
+        sc = self.sc
+        ctx = self.ctx
+        enabled = self.enabled
+        stats = sc.stats
+        chunk_len = sc.chunk_len
+        B = sc.batch_size
+        dedup = sc._dedup
+        fp_key = sc.ruleset_fingerprint
+        gap = sc.overlap
+        pack_max = chunk_len - gap
+        blake2b = hashlib.blake2b
+
+        slab_id: int | None = None
+        slab: np.ndarray | None = None
+        used = 0
+        # per-row feed metadata: (digest | None, [(fidx, win_start, win_end)])
+        meta: list[tuple[bytes | None, list[tuple[int, int, int]]]] = []
+        # slab rows awaiting the bulk strided gather from the current file
+        copy_rows: list[int] = []
+        copy_starts: list[int] = []
+        copy_win = None  # row_windows view over the current file's bytes
+        pack_pending: list[tuple[int, bytes]] = []
+        pack_len = 0
+        total = 0
+
+        class _FeedAbort(Exception):
+            pass
+
+        def flush_copies() -> None:
+            nonlocal copy_rows, copy_starts
+            if copy_rows:
+                # ONE vectorized gather for every full row the current
+                # file placed in this slab
+                slab[np.asarray(copy_rows)] = copy_win[np.asarray(copy_starts)]
+                copy_rows = []
+                copy_starts = []
+
+        def ensure_slab() -> None:
+            nonlocal slab_id, slab, used
+            if slab is None:
+                with ctx.span("secret.arena_wait"):
+                    got = self.arena.acquire(self._aborted)
+                if got is None:
+                    raise _FeedAbort
+                slab_id, slab = got
+                used = 0
+
+        def register_state(fidx: int, st: _FileState) -> bool:
+            """False when the scan degraded concurrently — the caller
+            must route the file to the host path instead (a state added
+            after :meth:`_degrade` swept the table would never resolve)."""
+            with self.lock:
+                if self.degraded:
+                    return False
+                self.states[fidx] = st
+                return True
+
+        def route_row(key, segs, nbytes) -> bool:
+            """True when the row resolved without an upload: served from
+            the hit cache or coalesced onto an identical in-flight row."""
+            if key is None:
+                return False
+            cached = sc._hit_get(key)
+            if cached is not None:
+                stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
+                if enabled:
+                    ctx.count("secret.bytes_dedup_hit", nbytes)
+                self._apply_hits([(segs, cached)])
+                return True
+            with self.lock:
+                waiting = self.row_waiters.get(key)
+                if waiting is not None:
+                    waiting.append(segs)
+                    coalesced = True
+                else:
+                    self.row_waiters[key] = []
+                    coalesced = False
+            if coalesced:
+                stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
+                if enabled:
+                    ctx.count("secret.bytes_dedup_hit", nbytes)
+            return coalesced
+
+        def flush() -> None:
+            nonlocal slab_id, slab, used, meta
+            flush_copies()
+            if not meta:
+                return  # empty slab: padding-only batches are never sent
+            n = next(b for b in sc._buckets if b >= len(meta))
+            stats.add(bytes_uploaded=n * chunk_len)
+            if enabled:
+                ctx.count("secret.bytes_uploaded", n * chunk_len)
+                ctx.sample("secret.queue_depth", self.in_q.qsize())
+            ok = self._put_slab((slab_id, slab[:n], meta))
+            if not ok:
+                self.arena.release(slab_id)
+            slab_id = None
+            slab = None
+            used = 0
+            meta = []
+            if not ok:
+                raise _FeedAbort
+            # bound pack-row staleness to one batch: a lone small file must
+            # not sit in pack_pending while big files stream past it — its
+            # unresolved state would stall in-order emission and let results
+            # accumulate unbounded on a streaming scan. The partial pack row
+            # rides the next batch instead (re-entry is shallow: the fresh
+            # meta holds one row, far below batch_size, so no second flush)
+            if pack_pending:
+                emit_pack()
+
+        def emit_pack() -> None:
+            nonlocal pack_len, used
+            if not pack_pending:
+                return
+            items = list(pack_pending)
+            pack_pending.clear()
+            pack_len = 0
+            key = None
+            if dedup:
+                if len(items) == 1:
+                    # single-segment row == plain chunk-row layout: share the
+                    # plain digest domain so it dedups across both paths
+                    key = blake2b(
+                        items[0][1], digest_size=16, key=fp_key
+                    ).digest()
+                else:
+                    h = blake2b(
+                        digest_size=16, key=fp_key, person=b"packed-row"
+                    )
+                    for _, d in items:
+                        h.update(len(d).to_bytes(4, "little"))
+                        h.update(d)
+                    key = h.digest()
+            segs = [(fidx, 0, len(d)) for fidx, d in items]
+            nbytes = sum(len(d) for _, d in items)
+            stats.add(chunks=1)
+            if route_row(key, segs, nbytes):
+                return
+            ensure_slab()
+            row = slab[used]
+            row[:] = 0  # zero guard gaps + stale tail (slabs are reused)
+            off = 0
+            for _, d in items:
+                row[off : off + len(d)] = np.frombuffer(d, dtype=np.uint8)
+                off += len(d) + gap
+            meta.append((key, segs))
+            used += 1
+            stats.add(chunks_uploaded=1)
+            if len(segs) > 1:
+                stats.add(
+                    rows_packed=1, files_packed=len(segs), bytes_packed=nbytes
+                )
+                if enabled:
+                    ctx.count("secret.bytes_packed", nbytes)
+            if used == B:
+                flush()
+
+        def add_small(fidx: int, data: bytes) -> None:
+            # small-file packing: files below a row's size accumulate and
+            # share one row, separated by >=span zero gaps (see module
+            # docstring for why packing cannot suppress a real match)
+            nonlocal pack_len
+            if pack_len and pack_len + gap + len(data) > chunk_len:
+                emit_pack()
+            pack_pending.append((fidx, data))
+            pack_len += (gap if pack_len else 0) + len(data)
+
+        def feed_big(fidx: int, path: str, data: bytes) -> None:
+            nonlocal used, copy_win
+            starts = chunk_spans(len(data), chunk_len, sc.overlap)
+            if not register_state(
+                fidx, _FileState(path=path, data=data, pending=len(starts))
+            ):
+                self._submit_host(fidx, path, data)
+                return
+            arr = np.frombuffer(data, dtype=np.uint8)
+            n = arr.size
+            stats.add(bytes_in=len(data), chunks=len(starts))
+            copy_win = row_windows(arr, chunk_len)
+            uploaded = 0
+            for s in starts:
+                end = min(s + chunk_len, n)
+                key = (
+                    blake2b(arr[s:end], digest_size=16, key=fp_key).digest()
+                    if dedup
+                    else None
+                )
+                segs = [(fidx, s, s + chunk_len)]
+                if route_row(key, segs, end - s):
+                    continue
+                ensure_slab()
+                if end - s == chunk_len:
+                    copy_rows.append(used)
+                    copy_starts.append(s)
+                else:
+                    # short tail row: copy, then zero the stale remainder
+                    slab[used, : end - s] = arr[s:end]
+                    slab[used, end - s :] = 0
+                meta.append((key, segs))
+                used += 1
+                uploaded += 1
+                if used == B:
+                    flush()
+            flush_copies()  # the view dies with this file's scope
+            copy_win = None
+            if uploaded:
+                stats.add(chunks_uploaded=uploaded)
+
+        feed_ok = True
+        try:
+            for fidx, (path, data) in enumerate(self.files):
+                total = fidx + 1
+                if self.stop.is_set() or self.error is not None:
+                    total -= 1  # not processed; the generator is closing
+                    break
+                if self.degraded:
+                    # device path is gone: route straight to the exact host
+                    # engine under the same confirm backpressure (files
+                    # already swept by _degrade keep their host results)
+                    pack_pending.clear()
+                    self._submit_host(fidx, path, data)
+                    continue
+                try:
+                    with ctx.span("secret.assemble"):
+                        if sc.exact.allow_path(path):
+                            # path-level global allowlist: skip the whole
+                            # file (ref: scanner.go:388-392) — no device work
+                            self._set_result(fidx, Secret(file_path=path))
+                        elif not data:
+                            # empty file: nothing for the device to match —
+                            # resolve host-side immediately (host-lane rules
+                            # still run there)
+                            self._submit_confirm(
+                                fidx,
+                                _FileState(path=path, data=data, pending=0),
+                            )
+                        elif sc._pack_small and len(data) <= pack_max:
+                            stats.add(bytes_in=len(data))
+                            if register_state(
+                                fidx,
+                                _FileState(path=path, data=data, pending=1),
+                            ):
+                                add_small(fidx, data)
+                            else:
+                                self._submit_host(fidx, path, data)
+                        else:
+                            feed_big(fidx, path, data)
+                except _FeedAbort:
+                    # mid-file abort: a registered state was already swept
+                    # onto the host path by _degrade; on plain shutdown the
+                    # generator is closing and nobody waits on this file
+                    if not self.degraded:
+                        break
+            if not self._aborted():
+                try:
+                    emit_pack()  # flush the partial pack row
+                    flush()  # dispatch the final partial slab
+                except _FeedAbort:
+                    pass
+        except BaseException:
+            # do NOT publish `total` on a failed feed: emission must see
+            # the error (set by _feed_guarded), not a truncated-but-
+            # "complete" input count that would silently swallow it
+            feed_ok = False
+            raise
+        finally:
+            if slab is not None:
+                # an unflushed (empty or aborted) slab goes straight back:
+                # padding rows never reach the dispatch queue or dedup keys
+                self.arena.release(slab_id)
+            with self.cond:
+                if feed_ok:
+                    self.total = total
+                self.cond.notify_all()
+            for _ in range(len(self.workers)):
+                self._put_sentinel()
