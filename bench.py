@@ -174,7 +174,11 @@ def bench_cpu_engine(scanner, files, budget_s: float = 20.0) -> dict:
 def warm_buckets(scanner) -> None:
     """Compile every dispatch bucket shape outside the timed region; under
     round-robin dispatch jit caches per (shape, device), so each bucket is
-    warmed once per stream."""
+    warmed once per stream. The scanner's own warm-up covers every fused
+    stage (prefilter + match) when present."""
+    if hasattr(scanner, "warm_buckets"):
+        scanner.warm_buckets()
+        return
     C = scanner.chunk_len
     streams = getattr(scanner._match, "n_streams", 1)
     for b in scanner._buckets:
@@ -239,12 +243,24 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=None):
         mbs = total_bytes / dt / (1024 * 1024)
         uploaded = s1["bytes_uploaded"] - s0["bytes_uploaded"]
         chunks = max(1, s1["chunks"] - s0["chunks"])
+        pre_rows = s1["rows_prefiltered"] - s0["rows_prefiltered"]
         return {
             "mbs": mbs,
             "findings": n_findings,
             "link_ratio": uploaded / total_bytes,
             "dedup_rate": (
                 (s1["chunks_dedup_hit"] - s0["chunks_dedup_hit"]) / chunks
+            ),
+            "prefilter_selectivity": (
+                (s1["rows_prefilter_hit"] - s0["rows_prefilter_hit"])
+                / pre_rows
+                if pre_rows
+                else None
+            ),
+            "nfa_skip_rate": (
+                (s1["rows_nfa_skipped"] - s0["rows_nfa_skipped"]) / pre_rows
+                if pre_rows
+                else None
             ),
             "ctx": ctx,
         }
@@ -256,16 +272,20 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=None):
         r = one_rep(enabled=False)
         link_after = bench_link(scanner, rng)
         rep_link = (link + link_after) / 2
-        reps_out.append(
-            {
-                "e2e_mbs": round(r["mbs"], 2),
-                "link_mbs": round(rep_link, 2),
-                "ratio": round(r["mbs"] / min(rep_link, device_mbs), 3),
-                "findings": r["findings"],
-                "link_bytes_per_corpus_byte": round(r["link_ratio"], 3),
-                "dedup_hit_rate": round(r["dedup_rate"], 3),
-            }
-        )
+        rep_doc = {
+            "e2e_mbs": round(r["mbs"], 2),
+            "link_mbs": round(rep_link, 2),
+            "ratio": round(r["mbs"] / min(rep_link, device_mbs), 3),
+            "findings": r["findings"],
+            "link_bytes_per_corpus_byte": round(r["link_ratio"], 3),
+            "dedup_hit_rate": round(r["dedup_rate"], 3),
+        }
+        if r["prefilter_selectivity"] is not None:
+            rep_doc["prefilter_selectivity"] = round(
+                r["prefilter_selectivity"], 4
+            )
+            rep_doc["nfa_skip_rate"] = round(r["nfa_skip_rate"], 4)
+        reps_out.append(rep_doc)
         link = link_after
     # the traced rep: stall verdict + per-rule/per-bucket profile for the
     # BENCH json, and the measured tracing overhead vs the untraced median
@@ -445,6 +465,136 @@ def bench_license(rng) -> dict:
             "top1_correct": correct,
             "top1_parity": f"{parity}/{n_license}",
             "license_files": n_license,
+        },
+    }
+
+
+def make_license_corpus(rng):
+    """License-heavy tree for the fused rep: full SPDX texts (LICENSE-file
+    workload), source files with real license headers (--license-full
+    workload), and source noise — every file license-eligible so the
+    separate-path accounting reflects what the license device path would
+    actually upload."""
+    from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
+
+    ids = sorted(FULL_TEXTS)
+    files = []
+    for i in range(48):
+        files.append(
+            (f"pkg_{i}/LICENSE", FULL_TEXTS[ids[i % len(ids)]].encode())
+        )
+    header = FULL_TEXTS["Apache-2.0"][:600]
+    for i in range(96):
+        body = " ".join(
+            "".join(chr(c) for c in rng.integers(97, 123, size=8))
+            for _ in range(500)
+        )
+        text = f"# {header}\n{body}" if i % 3 == 0 else body
+        files.append((f"src/mod_{i}.py", text.encode()))
+    return files
+
+
+def bench_fused(scanner, rng) -> dict:
+    """Combined ``--scanners secret,license`` rep over the shared arena:
+    one upload serves both detectors. Reports
+    ``device_bytes_uploaded_per_scanned_byte`` (the fused link cost) against
+    the sum today's SEPARATE paths would upload (secret uint8 rows + the
+    license device path's int32 gram rows for every collected text), plus
+    the prefilter selectivity on this corpus. Findings parity: the fused
+    gate's selected classification set must produce byte-identical license
+    results to classifying everything."""
+    from trivy_tpu.licensing.classify import LicenseClassifier
+    from trivy_tpu.licensing.fused import FusedLicenseGate
+    from trivy_tpu.ops import ngram_score as ng
+
+    files = make_license_corpus(rng)
+    total_bytes = sum(len(d) for _, d in files)
+    texts = [(p, d.decode("utf-8", "replace")) for p, d in files]
+
+    # separate-path license upload: what _classify_batch_device's gate
+    # stage would ship over the link for every collected text — padded
+    # int32 gram rows, row counts padded to the same power-of-two bucket
+    # ladder the device dispatch uses (classify.py bucket_rows)
+    from trivy_tpu.licensing import classify as _classify_mod
+
+    whashes, word_text, keys, gt = LicenseClassifier._batch_hashes(
+        [t for _, t in texts]
+    )
+    lic_upload = 0
+    if len(keys):
+        groups, _overflow = ng.pack_gram_rows(ng.fold32(keys), gt, len(texts))
+        max_rows = _classify_mod.MAX_DEVICE_ROWS
+        for rows, _tis in groups:
+            for off in range(0, len(rows), max_rows):
+                n = min(max_rows, len(rows) - off)
+                b = 8
+                while b < n:
+                    b *= 2
+                lic_upload += b * rows.shape[1] * 4
+
+    # register the gate stage BEFORE warming so the corpus-table build and
+    # the license kernel's per-bucket compiles land outside the timed region
+    scanner._ensure_license_stage()
+    warm_buckets(scanner)
+    scanner.clear_hit_cache()
+    gate = FusedLicenseGate(license_full=True)
+    s0 = scanner.stats.snapshot()
+    t0 = time.perf_counter()
+    secrets = list(scanner.scan_files(files, license_gate=gate))
+    clf = LicenseClassifier(backend="cpu")
+    selected = [
+        (p, t) for p, t in texts if gate.should_classify(p)
+    ]
+    per_file = clf.classify_batch([t for _, t in selected])
+    dt = time.perf_counter() - t0
+    s1 = scanner.stats.snapshot()
+
+    fused_findings = {
+        p: [f.name for f in fs] for (p, _), fs in zip(selected, per_file) if fs
+    }
+    all_results = clf.classify_batch([t for _, t in texts])
+    want = {
+        p: [f.name for f in fs] for (p, _), fs in zip(texts, all_results) if fs
+    }
+    if fused_findings != want:
+        missing = set(want) - set(fused_findings)
+        raise RuntimeError(
+            f"fused license parity mismatch: {sorted(missing)[:5]} dropped"
+        )
+    uploaded = s1["bytes_uploaded"] - s0["bytes_uploaded"]
+    pre_rows = max(1, s1["rows_prefiltered"] - s0["rows_prefiltered"])
+    fused_ratio = uploaded / total_bytes
+    separate_ratio = (uploaded + lic_upload) / total_bytes
+    mbs = total_bytes / dt / (1024 * 1024)
+    return {
+        "metric": "fused_secret_license_throughput",
+        "value": round(mbs, 2),
+        "unit": "MB/s",
+        "detail": {
+            "corpus_mb": round(total_bytes / (1024 * 1024), 2),
+            "files": len(files),
+            # the acceptance-criterion pair: fused link cost vs the sum of
+            # today's separate secret + license uploads
+            "device_bytes_uploaded_per_scanned_byte": round(fused_ratio, 3),
+            "separate_paths_bytes_per_scanned_byte": round(separate_ratio, 3),
+            "fused_vs_separate": round(fused_ratio / separate_ratio, 3)
+            if separate_ratio
+            else 1.0,
+            "license_gram_row_bytes": lic_upload,
+            "prefilter_selectivity": round(
+                (s1["rows_prefilter_hit"] - s0["rows_prefilter_hit"])
+                / pre_rows,
+                4,
+            ),
+            "license_files_covered": gate.files_covered,
+            "license_files_flagged": gate.files_flagged,
+            "license_rows_gated": s1["license_rows_gated"]
+            - s0["license_rows_gated"],
+            "classified": len(selected),
+            "classified_saved": len(texts) - len(selected),
+            "secret_findings": sum(len(s.findings) for s in secrets),
+            "license_findings": sum(len(v) for v in fused_findings.values()),
+            "parity": "ok",
         },
     }
 
@@ -713,6 +863,7 @@ def chaos() -> int:
 SMOKE_STAGES = (
     "secret.feed_wait",
     "secret.dispatch",
+    "secret.prefilter",
     "secret.device_wait",
     "secret.confirm",
 )
@@ -776,8 +927,10 @@ def smoke(trace_out=None, metrics_out=None) -> int:
         for i in range(8)
     ]
     warm_buckets(scanner)
+    s0 = scanner.stats.snapshot()
     with obs.scan_context(name="bench-smoke", enabled=True) as ctx:
         n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
+    s1 = scanner.stats.snapshot()
     if trace_out:
         obs_export.write_chrome_trace(ctx, trace_out)
     if metrics_out:
@@ -788,6 +941,28 @@ def smoke(trace_out=None, metrics_out=None) -> int:
         print(
             f"FATAL: declared pipeline stage(s) recorded zero spans: "
             f"{missing} (recorded: {sorted(recorded)})",
+            file=sys.stderr,
+        )
+        return 1
+    # prefilter sanity on the lure corpus: zero recorded rows means the
+    # stage silently vanished; selectivity pinned to exactly 0 or 1 means
+    # the candidate mask is degenerate (all-pass or all-drop — the lure
+    # corpus plants secrets in SOME files, so neither extreme is real)
+    pre_rows = s1["rows_prefiltered"] - s0["rows_prefiltered"]
+    pre_hits = s1["rows_prefilter_hit"] - s0["rows_prefilter_hit"]
+    if pre_rows <= 0:
+        print(
+            "FATAL: the prefilter stage recorded zero rows on the smoke "
+            "corpus (the on-device keyword pass silently dropped out)",
+            file=sys.stderr,
+        )
+        return 1
+    selectivity = pre_hits / pre_rows
+    if selectivity in (0.0, 1.0):
+        print(
+            f"FATAL: prefilter selectivity is exactly {selectivity:g} on "
+            f"the lure corpus ({pre_hits}/{pre_rows} rows) — the candidate "
+            f"mask is degenerate",
             file=sys.stderr,
         )
         return 1
@@ -821,6 +996,7 @@ def smoke(trace_out=None, metrics_out=None) -> int:
                 "findings": n_findings,
                 "stages": sorted(recorded),
                 "stall": stall.attribution(ctx),
+                "prefilter_selectivity": round(selectivity, 4),
                 "profile_rules": len(profile["rules"]),
                 "client_mode": {
                     "trace_id": client_trace_id,
@@ -837,6 +1013,10 @@ def smoke(trace_out=None, metrics_out=None) -> int:
 
 # regression gate: a >15% drop in any comparable metric fails the check
 REGRESSION_THRESHOLD = 0.15
+
+# metrics where UP is the regression direction (link cost per scanned
+# byte): a >threshold RISE fails exactly like a throughput drop
+LOWER_IS_BETTER = {"device_bytes_uploaded_per_scanned_byte"}
 
 
 def _load_bench_doc(path: str) -> dict:
@@ -860,8 +1040,9 @@ def _load_bench_doc(path: str) -> dict:
 
 def _metric_values(doc: dict) -> dict:
     """metric name -> numeric value (headline + healthy extra metrics).
-    Every bench metric is a rate (MB/s, pkgs/s, layers/s), so higher is
-    better across the board."""
+    Every bench metric is a rate (MB/s, pkgs/s, layers/s) — higher is
+    better — except the :data:`LOWER_IS_BETTER` link-cost metrics, lifted
+    here from the fused rep's detail so --check-regression covers them."""
     out = {}
     if isinstance(doc.get("value"), (int, float)):
         out[doc["metric"]] = float(doc["value"])
@@ -870,6 +1051,13 @@ def _metric_values(doc: dict) -> dict:
             continue
         if isinstance(m.get("value"), (int, float)):
             out[m["metric"]] = float(m["value"])
+        ratio = (m.get("detail") or {}).get(
+            "device_bytes_uploaded_per_scanned_byte"
+        )
+        if m.get("metric") == "fused_secret_license_throughput" and isinstance(
+            ratio, (int, float)
+        ):
+            out["device_bytes_uploaded_per_scanned_byte"] = float(ratio)
     return out
 
 
@@ -907,7 +1095,11 @@ def check_regression(prev_path: str, cur_path: str,
         delta = (cv - pv) / pv
         rows.append({"metric": name, "prev": pv, "cur": cv,
                      "delta_pct": round(delta * 100, 1)})
-        if delta < -threshold:
+        # link-cost metrics regress UPWARD (more bytes per scanned byte)
+        bad = delta > threshold if name in LOWER_IS_BETTER else (
+            delta < -threshold
+        )
+        if bad:
             regressions.append((name, pv, cv, delta))
     # the auto-gate inside `python bench.py` reports on stderr so stdout
     # stays ONE parseable headline doc (the contract _load_bench_doc and
@@ -923,7 +1115,7 @@ def check_regression(prev_path: str, cur_path: str,
     }), file=report_out or sys.stdout)
     for name, pv, cv, delta in regressions:
         print(
-            f"FATAL: {name} regressed {-delta * 100:.1f}% "
+            f"FATAL: {name} regressed {abs(delta) * 100:.1f}% "
             f"({pv:g} -> {cv:g}; threshold {threshold * 100:.0f}%)",
             file=sys.stderr,
         )
@@ -976,6 +1168,8 @@ def main():
     extra_metrics = []
     for name, fn in (
         ("secret_scan_dedup_throughput", lambda: bench_dedup(scanner, rng)),
+        ("fused_secret_license_throughput",
+         lambda: bench_fused(scanner, rng)),
         ("license_classify_throughput", lambda: bench_license(rng)),
         ("cve_match_rate", lambda: bench_cve(rng)),
         ("cached_image_layer_rate", bench_image_layers),

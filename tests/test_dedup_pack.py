@@ -111,6 +111,31 @@ def test_ruleset_fingerprint_invalidation():
     assert a.ruleset_fingerprint == c.ruleset_fingerprint  # same ruleset
     assert a.ruleset_fingerprint != d.ruleset_fingerprint  # rule removed
     assert a.ruleset_fingerprint != e.ruleset_fingerprint  # row shape differs
+    # the prefilter table rides the fingerprint: toggling the prefilter
+    # (cached values change schema/meaning) and editing a rule's KEYWORDS
+    # alone (same id/regex order would once have collided in the prefilter
+    # table) must both flip every dedup key
+    f = build(base, prefilter=False)
+    assert a.ruleset_fingerprint != f.ruleset_fingerprint
+    kw_edit = dict(
+        RESTRICTED,
+        rules=[
+            {"id": "r1", "regex": r"tok_[0-9a-f]{12}",
+             "keywords": ["tok_", "Tok2_"], "severity": "HIGH"},
+        ],
+    )
+    g = build(kw_edit)
+    assert a.ruleset_fingerprint != g.ruleset_fingerprint
+    # and the prefilter table digest itself sees the keyword edit (ascii
+    # fold applied): same table -> same digest, edited table -> new digest
+    assert (
+        a.compiled.prefilter_fingerprint()
+        == c.compiled.prefilter_fingerprint()
+    )
+    assert (
+        a.compiled.prefilter_fingerprint()
+        != g.compiled.prefilter_fingerprint()
+    )
 
 
 def test_persisted_cache_isolated_by_fingerprint():

@@ -63,6 +63,23 @@ def _artifact_option(ns, opts):
     secret_cfg = opts.get("secret_config")
     if secret_cfg and not os.path.exists(secret_cfg):
         secret_cfg = None
+    # fused device pass (README "Fused device pass"): when one scan runs
+    # both the secret and license scanners on a device backend, the secret
+    # feed's arena rows also carry the license gram gate so each scanned
+    # byte crosses the link ONCE for both detectors (--no-shared-arena
+    # opts out; backend=cpu has no device feed to share)
+    fused_license = None
+    if (
+        "secret" in scanners
+        and "license" in scanners
+        and device_backend != "cpu"
+        and not opts.get("no_shared_arena")
+    ):
+        from trivy_tpu.licensing.fused import FusedLicenseGate
+
+        fused_license = FusedLicenseGate(
+            license_full=bool(opts.get("license_full"))
+        )
     return ArtifactOption(
         skip_files=opts.get("skip_files", []),
         skip_dirs=opts.get("skip_dirs", []),
@@ -76,9 +93,11 @@ def _artifact_option(ns, opts):
             "java_db_path": opts.get("java_db"),
             "secret_dedup": not opts.get("no_secret_dedup"),
             "secret_pack": not opts.get("no_secret_pack"),
+            "secret_prefilter": not opts.get("no_secret_prefilter"),
             "secret_streams": max(0, int(opts.get("secret_streams") or 0)),
             "secret_inflight": max(0, int(opts.get("secret_inflight") or 0)),
             "host_fallback": not opts.get("no_host_fallback"),
+            "fused_license": fused_license,
             # own cache handle: the hit-vector store outlives any single
             # artifact's cache usage and redis/fs backends are cheap to dup
             "secret_hit_cache": (

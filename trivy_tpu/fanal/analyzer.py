@@ -229,6 +229,11 @@ class BatchAnalyzer:
 
     type: AnalyzerType
     version: int = 1
+    # finalize ordering within a group (lower first). The fused device pass
+    # makes this load-bearing: the secret analyzer's finalize drains the
+    # shared-arena scan whose license-gate verdicts the license analyzers'
+    # finalize consumes, so 'secret' must finalize before 'license-*'.
+    finalize_order: int = 50
 
     def required(self, file_path: str, info: FileInfo) -> bool:
         raise NotImplementedError
@@ -379,8 +384,14 @@ class AnalyzerGroup:
         return post_wanted
 
     def finalize(self, result: AnalysisResult, post_files: dict[AnalyzerType, dict[str, bytes]]) -> None:
-        """Run batch finalizers and post-analyzers, merging into result."""
-        for a in self.batch_analyzers:
+        """Run batch finalizers and post-analyzers, merging into result.
+        Batch finalizers run in ``finalize_order`` (secret before license:
+        the fused-pass gate verdicts must be complete before the license
+        analyzers query them); results merge order-independently."""
+        for a in sorted(
+            self.batch_analyzers,
+            key=lambda a: (getattr(a, "finalize_order", 50), a.type.value),
+        ):
             try:
                 result.merge(a.finalize())
             except FatalAnalyzerError as e:

@@ -69,6 +69,12 @@ class _LicenseBatchAnalyzer(BatchAnalyzer):
         self._backend = "cpu" if backend == "cpu" else "auto"
         extra = getattr(options, "extra", {}) or {}
         self._host_fallback = bool(extra.get("host_fallback", True))
+        # shared-arena fused pass (commands.py wires it for
+        # --scanners secret,license): the secret feed's device pass gates
+        # license candidacy against the SAME uploaded rows, so finalize
+        # classifies only flagged-or-uncovered files instead of everything.
+        # Runs after the secret finalize (BatchAnalyzer.finalize_order).
+        self._fused_gate = extra.get("fused_license")
 
     def collect(self, inp: AnalysisInput) -> None:
         text = inp.content.decode("utf-8", "replace")
@@ -80,13 +86,22 @@ class _LicenseBatchAnalyzer(BatchAnalyzer):
         files, self._files = self._files, []
         if not files:
             return AnalysisResult()
+        gate = self._fused_gate
+        if gate is not None:
+            targets = [
+                (p, t) for p, t in files if gate.should_classify(p)
+            ]
+        else:
+            targets = files
+        if not targets:
+            return AnalysisResult()
         clf = LicenseClassifier(
             backend=self._backend, host_fallback=self._host_fallback
         )
-        per_file = clf.classify_batch([t for _p, t in files])
+        per_file = clf.classify_batch([t for _p, t in targets])
         licenses = [
             LicenseFile(type=self.kind, file_path=path, findings=findings)
-            for (path, _t), findings in zip(files, per_file)
+            for (path, _t), findings in zip(targets, per_file)
             if findings
         ]
         return AnalysisResult(licenses=licenses)

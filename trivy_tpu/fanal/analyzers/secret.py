@@ -46,12 +46,13 @@ def _shared_scanner(
     config, backend: str, parallel: int,
     dedup: bool = True, pack_small: bool = True, hit_cache=None,
     host_fallback: bool = True, feed_streams: int = 0, inflight: int = 0,
+    prefilter: bool = True,
 ):
     key = (
         id(config) if config is not None else None,
         backend, parallel, dedup, pack_small,
         id(hit_cache) if hit_cache is not None else None,
-        host_fallback, feed_streams, inflight,
+        host_fallback, feed_streams, inflight, prefilter,
     )
     with _scanner_lock:
         if key not in _scanner_cache:
@@ -67,6 +68,7 @@ def _shared_scanner(
                         dedup=dedup, pack_small=pack_small,
                         hit_cache=hit_cache, host_fallback=host_fallback,
                         feed_streams=feed_streams, inflight=inflight,
+                        prefilter=prefilter,
                     )
                 except Exception as e:
                     # --backend failed at init (jax import, device probe,
@@ -118,9 +120,11 @@ class _StreamScan:
     """One walk's background device scan: a byte-bounded FileStream feeds
     a persistent ``scan_files`` call on a worker thread, so collection
     (walk + read) and device scanning overlap. ``finish`` closes the
-    stream, joins the consumer, and re-raises any scan failure."""
+    stream, joins the consumer, and re-raises any scan failure. With a
+    fused license gate, the same device pass also accumulates license
+    candidate verdicts against the shared arena rows."""
 
-    def __init__(self, scanner, ctx):
+    def __init__(self, scanner, ctx, license_gate=None):
         from trivy_tpu.secret.feed import FileStream
 
         self.stream = FileStream(STREAM_BUFFER_BYTES)
@@ -128,6 +132,7 @@ class _StreamScan:
         self.error: BaseException | None = None
         self._scanner = scanner
         self._ctx = ctx
+        self._license_gate = license_gate
         self.thread = threading.Thread(
             target=self._run, daemon=True, name="secret-stream-scan"
         )
@@ -138,7 +143,9 @@ class _StreamScan:
 
         try:
             with obs.activate(self._ctx):
-                for s in self._scanner.scan_files(self.stream):
+                for s in self._scanner.scan_files(
+                    self.stream, license_gate=self._license_gate
+                ):
                     if s.findings:
                         self.found.append(s)
         except BaseException as e:
@@ -168,6 +175,9 @@ class _StreamScan:
 class SecretAnalyzer(BatchAnalyzer):
     type = AnalyzerType.SECRET
     version = 1
+    # the fused-pass license gate must be fully populated before the
+    # license analyzers' finalize reads it (see AnalyzerGroup.finalize)
+    finalize_order = 10
 
     def __init__(self, options):
         cfg = None
@@ -201,11 +211,28 @@ class SecretAnalyzer(BatchAnalyzer):
         # async feed-path knobs (--secret-streams / --secret-inflight)
         self._feed_streams = int(extra.get("secret_streams", 0) or 0)
         self._inflight = int(extra.get("secret_inflight", 0) or 0)
+        # --no-secret-prefilter opts out of the on-device keyword pass
+        self._prefilter = bool(extra.get("secret_prefilter", True))
+        # fused license gate (shared-arena pass), created by commands.py
+        # when --scanners includes both secret and license
+        self._lic_gate = extra.get("fused_license")
         self._scanner = None  # built lazily so CPU-only runs never touch jax
         self._stream: _StreamScan | None = None
         self._found: list = []
 
     def required(self, file_path: str, info) -> bool:
+        ok = self._required_inner(file_path, info)
+        if not ok and self._lic_gate is not None and self._lic_gate.wants(
+            file_path
+        ):
+            # this file will never ride the device feed, so the fused gate
+            # can have no verdict for it — the license analyzer (whose
+            # eligibility rules differ: no size floor, no skip-dirs) must
+            # classify it itself
+            self._lic_gate.skip(file_path)
+        return ok
+
+    def _required_inner(self, file_path: str, info) -> bool:
         if info.size < 10:
             return False
         parts = file_path.split("/")
@@ -232,6 +259,7 @@ class SecretAnalyzer(BatchAnalyzer):
                 hit_cache=self._hit_cache,
                 host_fallback=self._host_fallback,
                 feed_streams=self._feed_streams, inflight=self._inflight,
+                prefilter=self._prefilter,
             )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 
@@ -246,6 +274,12 @@ class SecretAnalyzer(BatchAnalyzer):
         binary = utils.is_binary(head)
         ext = os.path.splitext(inp.file_path)[1]
         if binary and ext not in ALLOWED_BINARIES:
+            if self._lic_gate is not None and self._lic_gate.wants(
+                inp.file_path
+            ):
+                # binary-sniffed out of the secret feed: the fused gate
+                # never sees these bytes
+                self._lic_gate.skip(inp.file_path)
             return
         if len(inp.content) > LARGE_FILE_WARN:
             logger.warning(
@@ -262,6 +296,8 @@ class SecretAnalyzer(BatchAnalyzer):
         scanner = self._scanner
         if not hasattr(scanner, "scan_files"):
             # plain host engine: scan inline, nothing worth overlapping
+            # (no device pass ⇒ the fused gate stays unfed and the license
+            # analyzer classifies everything it collected — default-safe)
             s = scanner.scan_bytes(path, content)
             if s.findings:
                 self._found.append(s)
@@ -270,7 +306,9 @@ class SecretAnalyzer(BatchAnalyzer):
             from trivy_tpu import obs
 
             # the background consumer re-enters this walk's trace context
-            self._stream = _StreamScan(scanner, obs.current())
+            self._stream = _StreamScan(
+                scanner, obs.current(), license_gate=self._lic_gate
+            )
         # blocks only once STREAM_BUFFER_BYTES of content is waiting on
         # the device pipeline (walk-side backpressure); raises the scan
         # thread's error instead of buffering into a dead pipeline
@@ -304,6 +342,8 @@ class SecretAnalyzer(BatchAnalyzer):
         if self._stream is not None:
             stream, self._stream = self._stream, None
             stream.abort()
+        if self._lic_gate is not None:
+            self._lic_gate.degrade()
         self._found = []
 
 

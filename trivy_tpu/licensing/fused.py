@@ -1,0 +1,245 @@
+"""Shared-arena license gating: ride the secret feed's device pass.
+
+With ``--scanners secret,license`` every license-eligible file used to cross
+the host→device link twice — once as uint8 rows for the secret scanner and
+once as int32 gram rows for the license classifier. The fused pass uploads
+each byte once: the secret feed's resident arena rows also run the license
+gram gate (``ops/gram_gate.build_byte_gate_fn``), and the license analyzer
+classifies only the files the gate flagged (plus anything the gate could
+not cover). Classification itself is unchanged — the gate only *selects*,
+the exact classifier still produces the findings — so results stay
+byte-identical to the unfused path as long as the gate is a superset of
+"files with findings", which it is by construction:
+
+- a license finding needs a corpus-shared gram, a pooled phrase gram, or a
+  short fingerprint phrase; the first two surface as device gram-key hits,
+  the third as an anchor-word hit (its anchor word is part of the phrase);
+- gram/anchor windows wider than the chunk overlap (the only ones the
+  device can miss) are re-checked host-side by :meth:`FusedLicenseGate.
+  _host_patch` on the file's full bytes, at LUT-pass cost;
+- non-ASCII rows flag unconditionally (utf-8 decode divergence), and files
+  the secret feed never uploads (binaries, sub-10-byte files, skip-dirs,
+  allowlisted paths, degraded scans) count as *uncovered*, which the
+  license analyzer treats as "classify it yourself".
+
+Coverage is tracked per canonical path and is STICKY-uncovered: one layer
+of a multi-layer image marking a path unscannable forces classification for
+every layer's copy, so path collisions across concurrently-analyzed layers
+can only add work, never drop findings.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from trivy_tpu import log
+
+logger = log.logger("license:fused")
+
+__all__ = ["FusedLicenseGate", "wants_license_path"]
+
+# process-cached device gate fns + folded corpus tables, keyed by chunk_len
+_GATE_FN_CACHE: dict = {}
+_GATE_LOCK = threading.Lock()
+
+
+def _classifier_tables():
+    """The host classifier's corpus tables (process-cached by classify)."""
+    from trivy_tpu.licensing.classify import LicenseClassifier
+
+    probe = LicenseClassifier(backend="cpu")
+    probe._build_scoring()
+    return probe
+
+
+def get_gate_fn(chunk_len: int):
+    """Jitted ``[B, chunk_len] uint8 -> [B] bool`` license gate, one per
+    process per row shape (tables ride the jit closure, resident across
+    scans)."""
+    with _GATE_LOCK:
+        fn = _GATE_FN_CACHE.get(chunk_len)
+        if fn is None:
+            from trivy_tpu.licensing.classify import LicenseClassifier
+            from trivy_tpu.ops.gram_gate import build_byte_gate_fn
+
+            clf = _classifier_tables()
+            fn = build_byte_gate_fn(
+                chunk_len,
+                LicenseClassifier._LUT,
+                clf._gate_keys,
+                clf._anchor_sorted,
+                int(LicenseClassifier._P1),
+                int(LicenseClassifier._P2),
+                int(LicenseClassifier._HASH_P),
+                ngram=LicenseClassifier._NGRAM,
+            )
+            _GATE_FN_CACHE[chunk_len] = fn
+    return fn
+
+
+def wants_license_path(license_full: bool):
+    """Predicate over walk paths: which files the license analyzers will
+    ever ask the gate about (canonical license files; source headers only
+    under ``--license-full``). Everything else skips the gate stage
+    entirely, so secret-only traffic pays nothing for fusion."""
+    import os.path
+
+    from trivy_tpu.fanal.analyzers.license import (
+        _HEADER_EXTS,
+        _is_license_file,
+    )
+
+    def wants(path: str) -> bool:
+        if _is_license_file(path):
+            return True
+        if license_full:
+            return os.path.splitext(path)[1].lower() in _HEADER_EXTS
+        return False
+
+    return wants
+
+
+def _canon(path: str) -> str:
+    # the secret analyzer prefixes image-layer paths with '/', the license
+    # analyzer queries with the raw walk path — one key space for both
+    return path[1:] if path.startswith("/") else path
+
+
+class FusedLicenseGate:
+    """One scan run's license-candidate verdicts (thread-safe).
+
+    Producers: the secret analyzer/scanner — ``skip`` for files its device
+    feed will never carry, ``cover`` + row flags for files it does.
+    Consumer: the license analyzers' finalize (ordered after the secret
+    finalize via ``BatchAnalyzer.finalize_order``), via
+    :meth:`should_classify`.
+    """
+
+    def __init__(self, license_full: bool = False):
+        self.wants = wants_license_path(license_full)
+        self._lock = threading.Lock()
+        self._covered: set[str] = set()
+        self._skipped: set[str] = set()
+        self._flagged: set[str] = set()
+        self._degraded = False
+        # telemetry for bench / tests (row counts live on ScanStats)
+        self.files_covered = 0
+        self.files_flagged = 0
+        self.files_patched = 0  # host long-gram patch flagged the file
+
+    # -- producer side ------------------------------------------------------
+
+    def skip(self, path: str) -> None:
+        """Sticky: this path's bytes will not (all) ride the device pass."""
+        with self._lock:
+            self._skipped.add(_canon(path))
+
+    def cover(self, path: str) -> None:
+        p = _canon(path)
+        with self._lock:
+            if p not in self._covered:
+                self._covered.add(p)
+                self.files_covered += 1
+
+    def flag(self, path: str) -> None:
+        p = _canon(path)
+        with self._lock:
+            if p not in self._flagged:
+                self._flagged.add(p)
+                self.files_flagged += 1
+
+    def degrade(self) -> None:
+        """Device pass died: no verdict can be trusted — every query falls
+        back to exact classification."""
+        with self._lock:
+            if not self._degraded:
+                self._degraded = True
+                logger.warning(
+                    "fused license gate degraded; the license analyzer "
+                    "will classify every collected file"
+                )
+
+    # -- consumer side ------------------------------------------------------
+
+    def should_classify(self, path: str) -> bool:
+        """True unless the device pass covered every byte of this path and
+        flagged nothing — the only case it is safe to skip the classifier."""
+        p = _canon(path)
+        with self._lock:
+            if self._degraded or p in self._flagged:
+                return True
+            return p not in self._covered or p in self._skipped
+
+    # -- host patch for windows wider than the device coverage bound -------
+
+    def feed_file(self, path: str, data: bytes, span_bound: int) -> None:
+        """Register coverage for a file entering the device feed and
+        host-check the gram/anchor windows wider than ``span_bound`` (the
+        widest byte window guaranteed interior to some chunk). Cost when no
+        wide window exists — the overwhelmingly common case — is one LUT
+        pass + word-boundary scan, no hashing."""
+        self.cover(path)
+        if not data:
+            return
+        try:
+            if self._host_patch(data, span_bound):
+                with self._lock:
+                    self.files_patched += 1
+                self.flag(path)
+        except Exception as e:  # patch failure must fail SAFE (classify)
+            logger.warning("license host patch failed for %s: %s", path, e)
+            self.skip(path)
+
+    def _host_patch(self, data: bytes, span_bound: int) -> bool:
+        from trivy_tpu.licensing.classify import LicenseClassifier as C
+
+        b = np.frombuffer(data, dtype=np.uint8)
+        bm = C._LUT[b]
+        nz = bm != 0
+        if not nz.any():
+            return False
+        n = len(b)
+        prev = np.empty(n, dtype=bool)
+        prev[0] = False
+        prev[1:] = nz[:-1]
+        nxt = np.empty(n, dtype=bool)
+        nxt[-1] = False
+        nxt[:-1] = nz[1:]
+        starts = np.nonzero(nz & ~prev)[0]
+        ends = np.nonzero(nz & ~nxt)[0] + 1  # exclusive, aligned with starts
+        ng = C._NGRAM
+        long_words = np.nonzero(ends - starts > span_bound)[0]
+        if len(starts) >= ng:
+            gspan = ends[ng - 1 :] - starts[: len(starts) - ng + 1]
+            long_grams = np.nonzero(gspan > span_bound)[0]
+        else:
+            long_grams = np.zeros(0, dtype=np.int64)
+        if not len(long_words) and not len(long_grams):
+            return False
+        # hash every word once (same reduceat formula as the classifier)
+        pos = C._positions(n)
+        s0 = np.add.reduceat(bm, starts)
+        with np.errstate(over="ignore"):
+            s1 = np.add.reduceat(bm * pos, starts) - starts * s0
+            wh = s0 * C._P1 + s1 * C._P2
+        clf = _classifier_tables()
+        if len(long_words):
+            p = np.searchsorted(clf._anchor_sorted, wh[long_words])
+            p[p >= len(clf._anchor_sorted)] = 0
+            if len(clf._anchor_sorted) and (
+                clf._anchor_sorted[p] == wh[long_words]
+            ).any():
+                return True
+        if len(long_grams):
+            with np.errstate(over="ignore"):
+                keys = wh[long_grams].copy()
+                for j in range(1, ng):
+                    keys *= C._HASH_P
+                    keys += wh[long_grams + j]
+            p = np.searchsorted(clf._gate_keys, keys)
+            p[p >= len(clf._gate_keys)] = 0
+            if (clf._gate_keys[p] == keys).any():
+                return True
+        return False

@@ -56,8 +56,8 @@ def _topk_from_env() -> int:
 TOP_K = _topk_from_env()
 
 # internal per-rule slots: gate_hits, confirms, confirm_s, findings,
-# wasted_confirms, wasted_confirm_s
-_R = 6
+# wasted_confirms, wasted_confirm_s, prefilter_hits
+_R = 7
 
 
 class ScanProfile:
@@ -67,28 +67,49 @@ class ScanProfile:
         self._lock = threading.Lock()
         self._rules: dict[str, list] = {}
         self._buckets: dict[str, list] = {}  # key -> [dispatches, rows, wait_s]
+        # keyword-prefilter pass totals: [rows inspected, rows whose batch
+        # skipped the anchored/NFA dispatch, rows with >=1 candidate rule]
+        self._pre = [0, 0, 0]
 
     def __bool__(self) -> bool:
         with self._lock:
             return bool(self._rules or self._buckets)
 
+    def _rule(self, rule_id: str) -> list:
+        r = self._rules.get(rule_id)
+        if r is None:
+            r = self._rules[rule_id] = [0, 0, 0.0, 0, 0, 0.0, 0]
+        return r
+
     # -- recording ----------------------------------------------------------
 
     def gate_hit(self, rule_id: str, n: int = 1) -> None:
-        """The device prefilter flagged ``rule_id`` on ``n`` rows."""
+        """The device matcher flagged ``rule_id`` on ``n`` rows."""
         with self._lock:
-            r = self._rules.get(rule_id)
-            if r is None:
-                r = self._rules[rule_id] = [0, 0, 0.0, 0, 0, 0.0]
-            r[0] += n
+            self._rule(rule_id)[0] += n
+
+    def prefilter_hit(self, rule_id: str, n: int = 1) -> None:
+        """The keyword prefilter made ``rule_id`` a candidate on ``n``
+        rows. Per-rule candidate selectivity = prefilter_hits / the scan's
+        prefiltered row total — the signal that says which rules' keywords
+        are too common to gate anything."""
+        with self._lock:
+            self._rule(rule_id)[6] += n
+
+    def prefilter_rows(self, rows: int, skipped: int, hit_rows: int = 0) -> None:
+        """The prefilter pass inspected ``rows`` more rows, of which
+        ``skipped`` rode a batch that skipped the anchored dispatch and
+        ``hit_rows`` carried at least one candidate rule."""
+        with self._lock:
+            self._pre[0] += rows
+            self._pre[1] += skipped
+            self._pre[2] += hit_rows
 
     def confirm(self, rule_id: str, seconds: float, findings: int) -> None:
         """One exact host evaluation of ``rule_id`` took ``seconds`` and
         yielded ``findings`` surviving locations."""
         with self._lock:
-            r = self._rules.get(rule_id)
-            if r is None:
-                r = self._rules[rule_id] = [0, 0, 0.0, 0, 0, 0.0]
+            r = self._rule(rule_id)
             r[1] += 1
             r[2] += seconds
             r[3] += findings
@@ -111,17 +132,25 @@ class ScanProfile:
     def merge_dict(self, doc: dict) -> None:
         """Fold a serialized profile (:meth:`to_dict` output) into this one
         — used to merge a remote scan's profile into the client's."""
+        pre = doc.get("prefilter") or {}
+        if pre:
+            with self._lock:
+                rows = int(pre.get("rows", 0))
+                self._pre[0] += rows
+                self._pre[1] += int(pre.get("rows_nfa_skipped", 0))
+                self._pre[2] += int(
+                    pre.get("hit_rows", round(pre.get("selectivity", 0.0) * rows))
+                )
         for rid, f in (doc.get("rules") or {}).items():
             with self._lock:
-                r = self._rules.get(rid)
-                if r is None:
-                    r = self._rules[rid] = [0, 0, 0.0, 0, 0, 0.0]
+                r = self._rule(rid)
                 r[0] += int(f.get("gate_hits", 0))
                 r[1] += int(f.get("confirms", 0))
                 r[2] += float(f.get("confirm_ms", 0.0)) / 1e3
                 r[3] += int(f.get("findings", 0))
                 r[4] += int(f.get("wasted_confirms", 0))
                 r[5] += float(f.get("wasted_confirm_ms", 0.0)) / 1e3
+                r[6] += int(f.get("prefilter_hits", 0))
         for key, bf in (doc.get("buckets") or {}).items():
             with self._lock:
                 b = self._buckets.get(key)
@@ -140,10 +169,11 @@ class ScanProfile:
         with self._lock:
             rules = {k: list(v) for k, v in self._rules.items()}
             buckets = {k: list(v) for k, v in self._buckets.items()}
+            pre_rows, pre_skipped, pre_hit_rows = self._pre
         items = sorted(rules.items(), key=lambda kv: (-kv[1][2], -kv[1][0], kv[0]))
         if top_k is not None:
             items = items[:top_k]
-        return {
+        doc = {
             "rules": {
                 rid: {
                     "gate_hits": g,
@@ -153,8 +183,14 @@ class ScanProfile:
                     "wasted_confirms": wc,
                     "wasted_confirm_ms": round(wcs * 1e3, 3),
                     "fp_rate": round(wc / c, 4) if c else 0.0,
+                    "prefilter_hits": p,
+                    # per-rule candidate selectivity: what fraction of all
+                    # prefiltered rows this rule's keywords flagged
+                    "prefilter_selectivity": (
+                        round(p / pre_rows, 6) if pre_rows else 0.0
+                    ),
                 }
-                for rid, (g, c, cs, f, wc, wcs) in items
+                for rid, (g, c, cs, f, wc, wcs, p) in items
             },
             "buckets": {
                 k: {
@@ -165,6 +201,16 @@ class ScanProfile:
                 for k, (d, rows, s) in sorted(buckets.items())
             },
         }
+        if pre_rows:
+            doc["prefilter"] = {
+                "rows": pre_rows,
+                "rows_nfa_skipped": pre_skipped,
+                "hit_rows": pre_hit_rows,
+                # scan-level selectivity: fraction of rows carrying >=1
+                # candidate rule — the knob the smoke gate sanity-checks
+                "selectivity": round(pre_hit_rows / pre_rows, 6),
+            }
+        return doc
 
 
 def top_rules(doc: dict, k: int | None = None) -> list[tuple[str, dict]]:

@@ -27,6 +27,7 @@ BUCKETS = {
     "feed_wait": "feed-starved",
     "dispatch": "upload-bound",
     "device_wait": "device-bound",
+    "prefilter": "device-bound",  # blocking prefilter-result fetch
     "confirm": "confirm-bound",
     "finalize": "confirm-bound",
     "host_fallback": "confirm-bound",  # degraded-mode exact host rescans

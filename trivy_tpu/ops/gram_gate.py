@@ -1,0 +1,175 @@
+"""Device license gram gate over raw uint8 rows (the shared-arena fused
+pass, ROADMAP item 3: "upload each scanned byte once, run all device
+detectors against resident rows").
+
+The license classifier's existing device path hashes word 5-grams HOST-side
+and ships int32 gram rows over the link — a second upload of ~0.7 bytes per
+scanned byte on license-heavy trees. This kernel instead computes the gram
+hashes ON DEVICE from the secret scanner's resident arena rows and answers
+the only question the license pipeline needs per row: *could this row's
+file share any gram (or short-phrase anchor word) with the SPDX corpus?*
+Rows that gate are classified by the exact host/device classifier as
+before; rows that don't are license-free with no extra link bytes.
+
+Hash-domain soundness: the classifier's word hash is
+``s0*P1 + s1*P2 (mod 2^64)`` and gram keys fold words with
+``k = k*P + w (mod 2^64)`` — pure ring arithmetic, so truncation to 32 bits
+is a ring homomorphism: ``hash64(x) mod 2^32`` equals the same formula
+computed in uint32 with the truncated constants. The device therefore
+computes the EXACT low 32 bits of the host's hashes natively (no int64,
+which jax disables by default), and the corpus-side keys are just
+``keys64 & 0xFFFFFFFF`` of the classifier's existing tables. Equal words
+give equal keys on both sides; truncation collisions only ADD candidates
+(FP-only — the exact classifier discards them).
+
+Row-boundary contract (why this is a sound gate, not an exact one): a gram
+whose byte window sits fully interior to a chunk (with its preceding
+separator visible) hashes exactly; windows touching a chunk edge may hash
+garbage (false positives, harmless). The scanner's chunk overlap guarantees
+every window of byte-span < overlap is interior to SOME chunk, and the
+host-side long-gram patch (licensing/fused.py) covers the rare wider
+windows — together: device ∪ patch ⊇ host gate. Packed rows' ≥overlap zero
+gaps are separators, so cross-segment windows are FP-only too.
+
+Positions containing any byte ≥ 0x80 flag unconditionally: the license
+analyzer hashes utf-8-*decoded* text, so non-ASCII bytes diverge from the
+raw-byte stream — conservative fallback to exact classification keeps
+parity.
+
+Output granularity is per BLOCK (``GATE_BLOCK`` bytes), not per row: packed
+rows carry many small files, and a row-level verdict would let one license
+header flag every file sharing its row. Block flags let the scanner map
+hits back to the row segment (file) that produced them; a hit block
+spanning a segment boundary flags both neighbors (FP-only).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MASK = np.uint64(0xFFFFFFFF)
+
+# output block width: small enough that packed-row segments resolve to
+# their own blocks, large enough that the output stays tiny ([B, C/256])
+GATE_BLOCK = 256
+
+
+def gate_block(chunk_len: int) -> int:
+    """Largest power-of-two block ≤ GATE_BLOCK dividing ``chunk_len``
+    (degenerates to row-level for odd row shapes)."""
+    b = GATE_BLOCK
+    while b > 1 and chunk_len % b:
+        b //= 2
+    return b if chunk_len % b == 0 else chunk_len
+
+
+def fold_low32(keys64: np.ndarray) -> np.ndarray:
+    """Corpus-side key fold: low 32 bits of the int64 hash domain, sorted
+    unique, as uint32 — the ring-homomorphic image the device computes."""
+    k = np.asarray(keys64, dtype=np.int64).astype(np.uint64) & _MASK
+    return np.unique(k.astype(np.uint32))
+
+
+def build_byte_gate_fn(
+    chunk_len: int,
+    lut: np.ndarray,  # [256] int64: byte -> lowered value, separators -> 0
+    gate_keys64: np.ndarray,  # classifier's sorted int64 corpus gram keys
+    anchor_keys64: np.ndarray,  # classifier's short-phrase anchor word hashes
+    p1: int,  # classifier's word-hash mix constants (int64 domain)
+    p2: int,
+    hash_p: int,  # gram-fold constant
+    ngram: int = 5,
+):
+    """Jitted gate: ``chunks [B, chunk_len] uint8 -> [B, C/GATE_BLOCK]
+    bool`` per-block candidate flags. A block is True when a (low-32-
+    folded) corpus gram key or anchor word hash STARTS in it — or it
+    carries non-ASCII bytes. Tables ride the jit closure, so they upload
+    once per (shape, device) compilation and stay resident across every
+    batch of every scan. The block width is ``fn.block``."""
+    import jax
+    import jax.numpy as jnp
+
+    C = int(chunk_len)
+    BLK = gate_block(C)
+    lut32 = (np.asarray(lut, dtype=np.int64).astype(np.uint64) & _MASK).astype(
+        np.uint32
+    )
+    gate32 = fold_low32(gate_keys64)
+    anchor32 = fold_low32(anchor_keys64) if len(anchor_keys64) else None
+    P1 = np.uint32(np.uint64(np.int64(p1).astype(np.uint64)) & _MASK)
+    P2 = np.uint32(np.uint64(np.int64(p2).astype(np.uint64)) & _MASK)
+    HP = np.uint32(np.uint64(np.int64(hash_p).astype(np.uint64)) & _MASK)
+
+    def member(sorted_keys: np.ndarray, v: jax.Array) -> jax.Array:
+        """Elementwise membership of uint32 values in a sorted uint32 table."""
+        tbl = jnp.asarray(sorted_keys)
+        pos = jnp.clip(jnp.searchsorted(tbl, v), 0, tbl.shape[0] - 1)
+        return tbl[pos] == v
+
+    def gate(chunks: jax.Array) -> jax.Array:
+        B = chunks.shape[0]
+        vals = jnp.asarray(lut32)[chunks.astype(jnp.int32)]  # [B, C] uint32
+        nz = vals != 0
+        idx = jnp.arange(C, dtype=jnp.int32)
+        posw = idx.astype(jnp.uint32)
+
+        # word segmentation (identical to the host's zero-run boundaries)
+        prev_nz = jnp.pad(nz[:, :-1], ((0, 0), (1, 0)))
+        starts = nz & ~prev_nz
+        # next separator at-or-after i (word end, exclusive); no separator
+        # in the rest of the row -> C, which for a row whose real data runs
+        # to the edge sums the word through the row end (exact when the
+        # file ends there, FP-garbage when it continues — see module doc)
+        sep_idx = jnp.where(~nz, idx, C)
+        nsep = jax.lax.cummin(sep_idx, axis=1, reverse=True)
+
+        # prefix sums once, per-word sums by two gathers (host reduceat)
+        pref0 = jnp.pad(jnp.cumsum(vals, axis=1, dtype=jnp.uint32),
+                        ((0, 0), (1, 0)))
+        pref1 = jnp.pad(
+            jnp.cumsum(vals * posw[None, :], axis=1, dtype=jnp.uint32),
+            ((0, 0), (1, 0)),
+        )
+        e = nsep  # [B, C] int32 in [0, C]
+        s0 = jnp.take_along_axis(pref0, e, axis=1) - pref0[:, :C]
+        s1 = jnp.take_along_axis(pref1, e, axis=1) - pref1[:, :C]
+        s1 = s1 - posw[None, :] * s0  # rebase to word-local offsets
+        H = s0 * P1 + s1 * P2  # [B, C] uint32, valid at start positions
+
+        # chained next-start gathers give the gram's remaining word starts
+        start_idx = jnp.where(starts, idx, C)
+        ns = jnp.concatenate(
+            [
+                jax.lax.cummin(start_idx, axis=1, reverse=True)[:, 1:],
+                jnp.full((B, 1), C, dtype=jnp.int32),
+            ],
+            axis=1,
+        )
+        ns_pad = jnp.concatenate(
+            [ns, jnp.full((B, 1), C, dtype=jnp.int32)], axis=1
+        )
+        H_pad = jnp.concatenate(
+            [H, jnp.zeros((B, 1), dtype=jnp.uint32)], axis=1
+        )
+        key = H
+        p = idx[None, :].astype(jnp.int32) * jnp.ones((B, 1), jnp.int32)
+        for _ in range(ngram - 1):
+            p = jnp.take_along_axis(ns_pad, p, axis=1)
+            key = key * HP + jnp.take_along_axis(H_pad, p, axis=1)
+        valid = starts & (p < C)  # all ngram word starts inside the row
+
+        hit = member(gate32, key) & valid  # [B, C] positionwise
+        if anchor32 is not None:
+            hit = hit | (member(anchor32, H) & starts)
+        # non-ASCII positions: utf-8 decode on the license side diverges
+        # from raw bytes — flag for exact classification
+        hit = hit | (chunks >= 128)
+        return hit.reshape(B, C // BLK, BLK).any(axis=2)
+
+    jitted = jax.jit(gate)
+
+    def fn(chunks):
+        return jitted(chunks)
+
+    fn.block = BLK
+    return fn

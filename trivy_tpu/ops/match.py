@@ -57,12 +57,18 @@ def _word32(lit: bytes) -> int:
     return w
 
 
-def build_match_fn(compiled: CompiledRules, chunk_len: int):
+def build_match_fn(compiled: CompiledRules, chunk_len: int,
+                   include_keywords: bool = True):
     """Build the jitted matcher: ``chunks [B, chunk_len] uint8 -> [B, R] bool``.
 
     A True at ``[b, r]`` means rule ``compiled.rule_ids[r]`` *may* match
     within chunk ``b`` (for anchored rules the full device window was
     verified; for keyword rules a keyword substring is present).
+
+    With ``include_keywords=False`` the keyword lane is omitted — the
+    on-device prefilter (ops/prefilter.py) computes exactly those columns
+    in its own cheap first pass, so the full matcher only carries the
+    anchored programs and the two kernels never duplicate work.
     """
     C = chunk_len
     M = max(8, compiled.margin + 4)
@@ -105,15 +111,16 @@ def build_match_fn(compiled: CompiledRules, chunk_len: int):
             + jnp.pad(xw[:, 2:], ((0, 0), (0, 2))) * jnp.uint32(1 << 16)
             + jnp.pad(xw[:, 3:], ((0, 0), (0, 3))) * jnp.uint32(1 << 24)
         )
-        is_upper = (x >= 65) & (x <= 90)
-        xl = jnp.where(is_upper, x + 32, x)
-        xlw = xl.astype(jnp.uint32)
-        word_l = (
-            xlw
-            + jnp.pad(xlw[:, 1:], ((0, 0), (0, 1))) * jnp.uint32(1 << 8)
-            + jnp.pad(xlw[:, 2:], ((0, 0), (0, 2))) * jnp.uint32(1 << 16)
-            + jnp.pad(xlw[:, 3:], ((0, 0), (0, 3))) * jnp.uint32(1 << 24)
-        )
+        if include_keywords and compiled.keywords:
+            is_upper = (x >= 65) & (x <= 90)
+            xl = jnp.where(is_upper, x + 32, x)
+            xlw = xl.astype(jnp.uint32)
+            word_l = (
+                xlw
+                + jnp.pad(xlw[:, 1:], ((0, 0), (0, 1))) * jnp.uint32(1 << 8)
+                + jnp.pad(xlw[:, 2:], ((0, 0), (0, 2))) * jnp.uint32(1 << 16)
+                + jnp.pad(xlw[:, 3:], ((0, 0), (0, 3))) * jnp.uint32(1 << 24)
+            )
 
         def literal_hit(lit: bytes, data: jax.Array, wdata: jax.Array) -> jax.Array:
             """[B, C] bool: literal starts at position p."""
@@ -186,9 +193,10 @@ def build_match_fn(compiled: CompiledRules, chunk_len: int):
                 ok &= shift(na, -v.pre_len - 1)
             per_rule[ridx].append(ok.any(axis=1))
 
-        for ridx, kw in compiled.keywords:
-            ok = literal_hit(kw, xl, word_l)
-            per_rule[ridx].append(ok.any(axis=1))
+        if include_keywords:
+            for ridx, kw in compiled.keywords:
+                ok = literal_hit(kw, xl, word_l)
+                per_rule[ridx].append(ok.any(axis=1))
 
         cols = [
             functools.reduce(jnp.logical_or, hits)

@@ -105,10 +105,12 @@ def _group_variants(variants, budget: int):
     return [g for g, _ in groups]
 
 
-def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
+def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int,
+                          include_keywords: bool = True):
     """chunks [B, chunk_len] uint8 -> [B, R] bool. B must be a multiple of
     BLOCK_ROWS (use trivy_tpu.parallel.pad_batch); chunk_len a multiple
-    of 128."""
+    of 128. ``include_keywords=False`` omits the keyword lane (the
+    prefilter kernel computes those columns instead — ops/prefilter.py)."""
     C = chunk_len
     if C % 128:
         raise ValueError("chunk_len must be a multiple of 128")
@@ -271,7 +273,7 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int):
     # fold the keyword pass into the anchored-group kernels (shares the input
     # load and the per-kernel dispatch overhead); only the overflow past
     # KEYWORD_BATCH per kernel gets keyword-only kernels
-    kws = list(compiled.keywords)
+    kws = list(compiled.keywords) if include_keywords else []
     kw_slices: list[tuple] = []
     if var_groups and kws:  # all-anchored rulesets have no keywords to fold
         per = min(KEYWORD_BATCH, -(-len(kws) // len(var_groups)))

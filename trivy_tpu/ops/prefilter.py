@@ -1,0 +1,64 @@
+"""On-device keyword prefilter: the cheap first pass of the fused device
+scan (SURVEY.md §7's "vectorized Aho-Corasick first pass from the kernel
+table", realized as the packed multi-literal matcher the match kernels
+already use — no sequential automaton state survives vectorization, but the
+packed-word compare table is the same multi-pattern dictionary).
+
+Contract: ``chunks [B, C] uint8 -> [B, R] bool`` *candidate* mask over the
+compiled ruleset's full rule axis. ``candidates[b, r]`` is True iff one of
+rule ``r``'s ascii-lowered keywords occurs in row ``b`` (A-Z fold only —
+byte-identical to ``rules.ascii_lower`` on the host, see the case-fold
+contract there). Columns of rules without prefilter keywords are always
+False; ``CompiledRules.guarded`` says which columns are meaningful.
+
+How the scanner uses it (trivy_tpu/secret/tpu_scanner.py):
+
+- rows whose batch has no candidate for any *anchored* guarded rule (and
+  whose ruleset has no unguarded anchored rules) skip the full NFA/anchored
+  dispatch entirely — the dominant row population on real trees;
+- keyword-lane rules take their hit columns straight from this mask (the
+  full match kernel drops its keyword lane, ``include_keywords=False``);
+- candidates accumulate per FILE, and guarded rules are host-confirmed only
+  for candidate files — the reference's whole-file ``MatchKeywords``
+  semantics (scanner.go:174-186), which is what makes per-chunk gating
+  sound even when a rule's keyword and its match sit in different chunks.
+
+Both backends reuse the match-kernel builders on a keyword-only view of the
+compiled ruleset, so literal-compare semantics (packed words, zero padding,
+case fold) cannot drift between the prefilter and the matcher.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from trivy_tpu.secret.device_compile import CompiledRules
+
+
+def _keyword_only(compiled: CompiledRules) -> CompiledRules:
+    """A view of ``compiled`` whose only device programs are the prefilter
+    keywords (rule axis and padding margins unchanged, so outputs align
+    with the full matcher's [B, R] layout and the same padded-row plane)."""
+    return replace(
+        compiled,
+        variants=[],
+        keywords=list(compiled.prefilter_keywords),
+        prefilter_keywords=[],
+    )
+
+
+def build_prefilter_fn(compiled: CompiledRules, chunk_len: int,
+                       backend: str = "xla"):
+    """Jitted prefilter ``chunks [B, C] uint8 -> [B, R] bool``, or None
+    when no rule declares keywords (nothing to prefilter — the scanner
+    then runs the legacy single-pass matcher)."""
+    if not compiled.prefilter_keywords:
+        return None
+    kw_only = _keyword_only(compiled)
+    if backend == "pallas":
+        from trivy_tpu.ops.match_pallas import build_match_fn_pallas
+
+        return build_match_fn_pallas(kw_only, chunk_len)
+    from trivy_tpu.ops.match import build_match_fn
+
+    return build_match_fn(kw_only, chunk_len)

@@ -321,6 +321,111 @@ def round_robin_match_fn(
     return run
 
 
+class StagedDispatch:
+    """Place a batch on a device ONCE, then run several kernels against the
+    resident rows — the fused-pass dispatch surface (prefilter + anchored
+    match + license gram gate all read the same upload).
+
+    Three placement flavors behind one API, mirroring the match-fn wrappers
+    above:
+
+    - ``mesh``: rows shard over 'data' via one sharded ``device_put``;
+      stages are shard_map'd row-wise kernels.
+    - ``devices`` (round-robin): whole batches to the next healthy device,
+      per-device :class:`CircuitBreaker`, per-stage jit cached per device.
+    - neither: default placement, device index fixed at 0.
+
+    ``put`` owns batch-axis padding and the ``device.dispatch`` fault gate
+    (one check per batch, exactly like the legacy ``dispatch``); ``run``
+    launches a named stage asynchronously on the resident array. Fetch-time
+    outcomes feed back through ``record_result`` as before.
+    """
+
+    def __init__(self, mesh=None, devices=None, rows_multiple: int = 1,
+                 breaker: CircuitBreaker | None = None):
+        self.mesh = mesh
+        self.devices = list(devices) if devices is not None else None
+        self.rows_multiple = max(1, rows_multiple)
+        self._stages: dict = {}
+        if mesh is not None:
+            self.pad_to = int(mesh.shape["data"]) * self.rows_multiple
+            self.n_streams = 1
+            self.breaker = None
+        elif self.devices:
+            self.pad_to = self.rows_multiple
+            self.n_streams = len(self.devices)
+            self.breaker = breaker or CircuitBreaker(len(self.devices))
+            self._lock = threading.Lock()
+            self._next = 0
+        else:
+            self.pad_to = self.rows_multiple
+            self.n_streams = 1
+            self.breaker = None
+
+    def add_stage(self, name: str, fn, out_axes: int = 2) -> None:
+        """Register a row-wise kernel ``[B, C] -> [B, ...]``. ``out_axes``
+        is the output rank (2 for per-rule masks, 1 for per-row flags) —
+        the mesh flavor needs it for the shard_map out_specs."""
+        if self.mesh is not None:
+            spec_out = P("data", None) if out_axes == 2 else P("data")
+            fn = _shard_map(
+                fn, mesh=self.mesh, in_specs=(P("data", None),),
+                out_specs=spec_out,
+            )
+        self._stages[name] = jax.jit(fn)
+
+    def has_stage(self, name: str) -> bool:
+        return name in self._stages
+
+    def stage_fn(self, name: str):
+        """The raw jitted stage (pure, traceable) — bench/warm-up hook."""
+        return self._stages[name]
+
+    def put(self, chunks: np.ndarray):
+        """Pad + place one batch; returns ``(resident_array, device_idx)``.
+        Raises :class:`DevicesUnavailable` when every round-robin device is
+        circuit-broken."""
+        if self.pad_to > 1:
+            chunks = pad_batch(chunks, self.pad_to)
+        if self.mesh is not None:
+            faults.check("device.dispatch", key="d0")
+            return (
+                jax.device_put(chunks, batch_sharding(self.mesh)), None,
+            )
+        if self.devices:
+            with self._lock:
+                i = self.breaker.next_device(self._next)
+                if i is None:
+                    raise DevicesUnavailable(
+                        f"all {len(self.devices)} dispatch devices are "
+                        f"circuit-broken"
+                    )
+                self._next = (i + 1) % len(self.devices)
+            try:
+                faults.check("device.dispatch", key=f"d{i}")
+                with obs.current().span(f"mesh.d{i}.dispatch"):
+                    dev = jax.device_put(chunks, self.devices[i])
+            except Exception:
+                self.breaker.record_failure(i)
+                raise
+            obs.current().count(f"mesh.d{i}.batches")
+            return dev, i
+        faults.check("device.dispatch", key="d0")
+        return jax.device_put(chunks), None
+
+    def run(self, name: str, dev, device_idx=None):
+        """Launch stage ``name`` on an already-resident batch (async)."""
+        return self._stages[name](dev)
+
+    def record_result(self, i, ok: bool) -> None:
+        if self.breaker is None or i is None:
+            return
+        if ok:
+            self.breaker.record_success(i)
+        else:
+            self.breaker.record_failure(i)
+
+
 def corpus_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Corpus fingerprint tables: leading shard axis over 'model', payload
     replicated across 'data'. Used to commit the license n-gram corpus

@@ -123,10 +123,42 @@ class CompiledRules:
     margin: int  # max bytes a program inspects beyond/behind a position
     span: int = 8  # required chunk overlap (max device-window extent)
     anchored_rule_ids: list[str] = field(default_factory=list)
+    # keyword-prefilter table: (rule_index, ascii-lowered keyword) for EVERY
+    # device rule that declares keywords — the keyword lane's own entries
+    # plus anchored-lane rules that also declare keywords. The on-device
+    # prefilter (ops/prefilter.py) runs this table over every arena slab
+    # first; rows with zero candidate rules skip the anchored/NFA dispatch
+    # entirely and candidates gate host confirms at file level (the
+    # reference's MatchKeywords is a whole-file test, scanner.go:174-186).
+    prefilter_keywords: list[tuple[int, bytes]] = field(default_factory=list)
 
     @property
     def num_rules(self) -> int:
         return len(self.rule_ids)
+
+    @property
+    def guarded(self) -> np.ndarray:
+        """[R] bool: rules whose keywords are in the prefilter table — a
+        prefilter miss across a whole file means the rule cannot match it
+        (keywords are a whole-file predicate in the exact engine)."""
+        g = np.zeros(self.num_rules, dtype=bool)
+        for ridx, _ in self.prefilter_keywords:
+            g[ridx] = True
+        return g
+
+    def prefilter_fingerprint(self) -> bytes:
+        """Digest of the prefilter table: any keyword add/remove/edit (or a
+        rule-index renumbering) flips it. Mixed into the dedup-cache key so
+        cached hit/candidate vectors can never survive a ruleset keyword
+        edit."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        for ridx, kw in sorted(self.prefilter_keywords):
+            h.update(ridx.to_bytes(4, "little"))
+            h.update(len(kw).to_bytes(4, "little"))
+            h.update(kw)
+        return h.digest()
 
 
 def _category_chars(cat) -> frozenset:
@@ -347,6 +379,20 @@ def compile_rules(rules: list[Rule]) -> CompiledRules:
     anchored_rule_ids: list[str] = []
     class_index: dict[frozenset, int] = {}
 
+    prefilter_keywords: list[tuple[int, bytes]] = []
+
+    def kw_bytes(rule: Rule) -> list[bytes]:
+        # a keyword with chars >255 can never be a substring of latin-1
+        # scan content, so dropping it keeps the device keyword test
+        # EXACTLY equal to the host's match_keywords, not merely sound
+        out = []
+        for kw in rule.lower_keywords:
+            try:
+                out.append(kw.encode("latin-1"))
+            except UnicodeEncodeError:
+                continue
+        return out
+
     for rule in rules:
         prog = compile_rule(rule)
         if prog is not None:
@@ -359,11 +405,22 @@ def compile_rules(rules: list[Rule]) -> CompiledRules:
                         class_index[c.chars] = len(class_index)
                     c.class_id = class_index[c.chars]
                 variants.append((ridx, v))
+            # anchored rules that also declare keywords join the prefilter
+            # table: their confirms gate on a whole-file keyword candidate
+            kb = kw_bytes(rule)
+            if kb:
+                prefilter_keywords.extend((ridx, k) for k in kb)
         elif rule.lower_keywords:
+            kb = kw_bytes(rule)
+            if not kb:
+                # no representable keyword: nothing for the device to find
+                host_rule_ids.append(rule.id)
+                continue
             ridx = len(rule_ids)
             rule_ids.append(rule.id)
-            for kw in rule.lower_keywords:
-                keywords.append((ridx, kw.encode("latin-1")))
+            for k in kb:
+                keywords.append((ridx, k))
+            prefilter_keywords.extend((ridx, k) for k in kb)
         else:
             host_rule_ids.append(rule.id)
 
@@ -382,6 +439,11 @@ def compile_rules(rules: list[Rule]) -> CompiledRules:
     for _, kw in keywords:
         margin = max(margin, len(kw))
         span = max(span, len(kw))
+    for _, kw in prefilter_keywords:
+        # anchored-lane keywords run only in the prefilter kernel, which
+        # shares the padded-row layout — the overlap must cover them too
+        margin = max(margin, len(kw))
+        span = max(span, len(kw))
 
     return CompiledRules(
         rule_ids=rule_ids,
@@ -392,4 +454,5 @@ def compile_rules(rules: list[Rule]) -> CompiledRules:
         margin=margin,
         span=span,
         anchored_rule_ids=anchored_rule_ids,
+        prefilter_keywords=prefilter_keywords,
     )

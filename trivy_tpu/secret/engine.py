@@ -26,6 +26,7 @@ from trivy_tpu.secret.rules import (
     SECRET_GROUP,
     AllowRule,
     Rule,
+    ascii_lower,
     builtin_allow_rules,
     builtin_rules,
 )
@@ -385,7 +386,9 @@ class SecretScanner:
         # enabled check per file when tracing is off
         ctx = obs.current()
         prof = ctx.profile() if ctx.enabled else None
-        lower = content.lower()
+        # ASCII-only fold, matching Rule.lower_keywords and the device
+        # prefilter (bytes A-Z, no locale) — see rules.ascii_lower
+        lower = ascii_lower(content)
         global_blocks = self.global_block_spans(content)
         hits: list[tuple[Rule, Location]] = []
         for rule in self.rules_for_path(file_path):

@@ -42,6 +42,32 @@ _WORD_PREFIX = r"(?:^|[^0-9a-zA-Z])"
 # Name of the regex group holding the secret when a rule wraps its payload.
 SECRET_GROUP = "secret"
 
+# ASCII-only case fold (bytes A-Z -> a-z, nothing else). The device keyword
+# prefilter can only fold bytes 0x41-0x5A, so the host pre-lowering of
+# keywords AND the content lowering the keyword test runs against must use
+# the exact same fold — str.lower()'s unicode/locale folds (e.g. 'À'→'à',
+# 'İ'→'i̇') would make host and device disagree on non-ASCII bytes, which
+# for a custom rule is a silent device false negative.
+_ASCII_LOWER_BYTES = bytes(
+    c + 32 if 0x41 <= c <= 0x5A else c for c in range(256)
+)
+
+
+def ascii_lower(s: str) -> str:
+    """Fold A-Z to a-z byte-wise; all other characters (including latin-1
+    accented letters) pass through unchanged. ``s`` must be latin-1-safe
+    (scan content is latin-1-decoded bytes, so it always is); the bytes
+    round-trip keeps the fold C-speed on multi-MB content."""
+    return s.encode("latin-1").translate(_ASCII_LOWER_BYTES).decode("latin-1")
+
+
+def ascii_lower_any(s: str) -> str:
+    """:func:`ascii_lower` for strings that may contain non-latin-1 chars
+    (user-supplied keywords): folds A-Z, passes everything else through."""
+    return "".join(
+        chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s
+    )
+
 
 def ws(pattern: str) -> str:
     """Wrap ``pattern`` so it only matches at a word start, capturing the
@@ -209,8 +235,11 @@ class Rule:
             sre_c, sre_parse = _sre_c, _sre_parse
 
             def fold_char(chars: frozenset) -> str | None:
-                """Single lowercase char every member folds to, or None."""
-                folded = {chr(c).lower() for c in chars if c < 256}
+                """Single char every member ASCII-folds to, or None — the
+                same A-Z-only fold the keyword test uses."""
+                folded = {
+                    chr(_ASCII_LOWER_BYTES[c]) for c in chars if c < 256
+                }
                 return folded.pop() if len(folded) == 1 else None
 
             def single(op, av) -> frozenset | None:
@@ -349,7 +378,9 @@ class Rule:
 
     @cached_property
     def lower_keywords(self) -> list[str]:
-        return [k.lower() for k in self.keywords]
+        # ASCII fold only — must equal the device prefilter's A-Z fold (see
+        # ascii_lower); keywords are matched against ascii_lower(content)
+        return [ascii_lower_any(k) for k in self.keywords]
 
     def match_path(self, path: str) -> bool:
         return self.path_re is None or self.path_re.search(path) is not None

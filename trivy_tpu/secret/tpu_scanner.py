@@ -46,6 +46,15 @@ the dispatch queue + per-stream windows + assembly margin); the confirm
 semaphore bounds retained file bytes; together they are the streaming-RSS
 guarantee the bench gate enforces.
 
+The device side is a FUSED pass (README "Fused device pass"): each batch
+is placed once (`parallel.mesh.StagedDispatch`) and every detector reads
+the resident rows — the keyword prefilter first (its candidate mask gates
+whether the anchored matcher dispatches at all, feeds keyword-lane hits
+directly, and accumulates per-file candidates that gate host confirms at
+whole-file MatchKeywords semantics), then the anchored matcher when
+needed, then (with ``--scanners secret,license``) the license gram gate
+(`licensing/fused.py`) so license candidacy costs zero extra link bytes.
+
 The feed path sends link bytes ≪ corpus bytes (the host→device link, not
 the kernel, is the e2e ceiling):
 
@@ -135,7 +144,8 @@ CONFIRM_WORKERS = 4
 HIT_CACHE_ENTRIES = 1 << 16
 # bump when device-compile semantics change in a way that alters hit
 # vectors for identical (rules, chunk) inputs — invalidates persisted caches
-HIT_CACHE_VERSION = 1
+# (v2: values grew prefilter candidate masks + nfa/license flags)
+HIT_CACHE_VERSION = 2
 # re-dispatches allowed per failed batch before the failure escalates to
 # the scan-level fallback ladder (OOM-shaped splits don't consume this
 # budget: halving strictly shrinks the batch, so it terminates on its own)
@@ -177,6 +187,15 @@ class _FileState:
     pending: int  # chunks not yet matched
     # candidate rule index -> chunk windows (byte spans) where it hit
     rules: dict[int, list[tuple[int, int]]] = field(default_factory=dict)
+    # prefilter candidate rules accumulated over EVERY chunk of the file
+    # (None when the prefilter pass is off): guarded rules are confirmed
+    # only when present here — the reference's whole-file MatchKeywords
+    # gate, applied from device data instead of a host lowercase scan
+    cand: set[int] | None = None
+    # guarded anchored rules whose kernel was SKIPPED for >=1 chunk of this
+    # file (batch had no candidates): their windows may be incomplete, so a
+    # candidate among them confirms via full scan instead of windows
+    unchecked: set[int] = field(default_factory=set)
 
 
 class ScanStats:
@@ -200,6 +219,12 @@ class ScanStats:
         "batch_retries",     # failed batches re-dispatched whole
         "batch_splits",      # OOM-shaped failures answered by halving
         "degraded",          # scans that fell back to the exact host path
+        "rows_prefiltered",  # rows the keyword prefilter pass inspected
+        "rows_prefilter_hit",  # rows with >=1 candidate rule
+        "rows_nfa_skipped",  # rows whose batch skipped the anchored kernel
+        "batches_nfa_skipped",  # batches resolved by the prefilter alone
+        "license_rows_gated",    # arena rows the license gram gate read
+        "license_rows_flagged",  # rows that flagged a license candidate
     )
 
     def __init__(self):
@@ -245,6 +270,8 @@ class TpuSecretScanner:
         # (one per round-robin device; SINGLE_DEVICE_STREAMS on one
         # accelerator; 2 on the CPU backend)
         inflight: int = 0,  # in-flight batches per stream; 0 = FEED_INFLIGHT
+        prefilter: bool = True,  # on-device keyword prefilter first pass
+        # (--no-secret-prefilter); auto-disabled when no rule has keywords
     ):
         import jax
 
@@ -254,18 +281,56 @@ class TpuSecretScanner:
             platform = jax.devices()[0].platform
             backend = "pallas" if platform not in ("cpu", "METAL") else "xla"
         self.backend = backend
+        # fused-pass prefilter: a cheap keyword-only kernel runs over every
+        # slab first; the full matcher drops its keyword lane and batches
+        # with no anchored candidates skip it entirely (ops/prefilter.py)
+        self.prefilter_on = bool(prefilter) and bool(
+            self.compiled.prefilter_keywords
+        )
         if backend == "pallas":
             from trivy_tpu.ops.match_pallas import BLOCK_ROWS, build_match_fn_pallas
 
             self.chunk_len = chunk_len or PALLAS_CHUNK_LEN
             self.batch_size = batch_size or PALLAS_BATCH
             rows_mult = BLOCK_ROWS
-            match_fn = build_match_fn_pallas(self.compiled, self.chunk_len)
+            match_fn = build_match_fn_pallas(
+                self.compiled, self.chunk_len,
+                include_keywords=not self.prefilter_on,
+            )
         else:
             self.chunk_len = chunk_len or DEFAULT_CHUNK_LEN
             self.batch_size = batch_size or DEFAULT_BATCH
             rows_mult = 1
-            match_fn = build_match_fn(self.compiled, self.chunk_len)
+            match_fn = build_match_fn(
+                self.compiled, self.chunk_len,
+                include_keywords=not self.prefilter_on,
+            )
+        if self.prefilter_on:
+            from trivy_tpu.ops.prefilter import build_prefilter_fn
+
+            self._prefilter_fn = build_prefilter_fn(
+                self.compiled, self.chunk_len, backend=backend
+            )
+        else:
+            self._prefilter_fn = None
+        # rule-axis index tables the fused pass resolves against
+        g = self.compiled.guarded
+        anchored_idx = {i for i, _ in self.compiled.variants}
+        self._kw_lane_cols = np.asarray(
+            sorted({i for i, _ in self.compiled.keywords}), dtype=np.int64
+        )
+        self._guarded_anchored = frozenset(
+            i for i in anchored_idx if g[i]
+        )
+        self._guarded_anchored_cols = np.asarray(
+            sorted(self._guarded_anchored), dtype=np.int64
+        )
+        # anchored rules with no keywords are never prefilter-gated: their
+        # presence forces the anchored kernel on every batch
+        self._has_unguarded_anchored = any(not g[i] for i in anchored_idx)
+        self._guarded_ids = frozenset(
+            self.compiled.rule_ids[i] for i in np.nonzero(g)[0]
+        )
         self.overlap = max(64, self.compiled.span + 1)
         if self.overlap > self.chunk_len // 2:
             raise ValueError(
@@ -301,10 +366,19 @@ class TpuSecretScanner:
         for r in self.exact.rules:
             fp.update(repr((r.id, r.regex, r.keywords, r.path)).encode())
             fp.update(b"\x00")
+        # prefilter table fingerprint: cached vectors now carry candidate
+        # masks derived from the keyword table, so a keyword add/remove/edit
+        # — or toggling the prefilter itself, which changes the cached value
+        # semantics (nfa_ran bookkeeping) — must flip every key
+        if self.prefilter_on:
+            fp.update(b"pf:")
+            fp.update(self.compiled.prefilter_fingerprint())
+        else:
+            fp.update(b"pf-off")
         self.ruleset_fingerprint = fp.digest()
         self._dedup = dedup
         self._pack_small = pack_small
-        self._hit_lru: OrderedDict[bytes, tuple[int, ...]] = OrderedDict()
+        self._hit_lru: OrderedDict[bytes, tuple] = OrderedDict()
         self._hit_lru_max = hit_cache_entries
         self._hit_lock = threading.Lock()
         self._hit_persist = hit_cache
@@ -312,12 +386,7 @@ class TpuSecretScanner:
         self._batch_retries = batch_retries
         self.stats = ScanStats()
 
-        from trivy_tpu.parallel.mesh import (
-            pad_batch,
-            round_robin_match_fn,
-            sharded_match_fn,
-            single_stream_match_fn,
-        )
+        from trivy_tpu.parallel.mesh import StagedDispatch, pad_batch
 
         if dispatch not in ("auto", "single", "round_robin"):
             raise ValueError(
@@ -335,26 +404,32 @@ class TpuSecretScanner:
             ):
                 rr_devices = local
 
-        if mesh is not None:
-            inner = sharded_match_fn(match_fn, mesh, rows_multiple=rows_mult)
-            dp = inner.data_parallelism
-            self._match = single_stream_match_fn(
-                lambda b: inner(pad_batch(b, dp))
-            )
-            row_multiple = dp
-        elif rr_devices is not None:
-            self._match = round_robin_match_fn(
-                match_fn, rr_devices, rows_multiple=rows_mult
-            )
-            row_multiple = rows_mult
-        elif rows_mult > 1:
-            self._match = single_stream_match_fn(
-                lambda b: match_fn(pad_batch(b, rows_mult))
-            )
-            row_multiple = rows_mult
-        else:
-            self._match = single_stream_match_fn(match_fn)
-            row_multiple = 1
+        # fused-pass dispatch: ONE placement per batch, every device
+        # detector (prefilter, anchored match, license gram gate) runs
+        # against the resident rows — the upload is shared, not repeated
+        self._staged = StagedDispatch(
+            mesh=mesh, devices=rr_devices, rows_multiple=rows_mult
+        )
+        self._staged.add_stage("match", match_fn, out_axes=2)
+        if self._prefilter_fn is not None:
+            self._staged.add_stage("prefilter", self._prefilter_fn, out_axes=2)
+        self._stage_lock = threading.Lock()
+        row_multiple = self._staged.pad_to
+
+        # bench/back-compat surface: the raw jitted match kernel (pure and
+        # traceable, pads short batches itself) plus the stream/breaker
+        # attributes tests and warm-up loops key off
+        match_stage = self._staged.stage_fn("match")
+        pad_to = self._staged.pad_to
+
+        def _compat_match(chunks):
+            return match_stage(pad_batch(chunks, pad_to))
+
+        if rr_devices is not None:
+            _compat_match.n_streams = len(rr_devices)
+            _compat_match.breaker = self._staged.breaker
+            _compat_match.devices = rr_devices
+        self._match = _compat_match
 
         # transfer-stream sizing: one worker thread per round-robin device
         # (per-device copies overlap each other), several streams on one
@@ -392,12 +467,28 @@ class TpuSecretScanner:
         self._buckets = sorted(buckets)
 
     # -- dedup hit cache ----------------------------------------------------
+    #
+    # Cached value per row digest (the "row verdict"): a 4-tuple
+    #   (hit_rules, cand_rules, nfa_ran, lic)
+    # - hit_rules: device hit vector (anchored hits + keyword-lane hits)
+    # - cand_rules: prefilter candidate rules (== hit_rules' keyword part
+    #   plus anchored-lane keyword presences); () when the prefilter is off
+    # - nfa_ran: False when the row's batch skipped the anchored kernel —
+    #   a conservative, row-pure marker: replaying it marks every guarded
+    #   anchored rule unchecked for the row's file, so a candidate there
+    #   confirms via full scan (soundness does not depend on which batch
+    #   the row originally rode)
+    # - lic: fused license-gate verdict: True/False, or None when the
+    #   row's batch never ran the gate (consumers must not trust None)
+    # The digest is keyed with the ruleset fingerprint (which now folds in
+    # the prefilter table) plus a ':lic' namespace when a license gate is
+    # active, so entries can never cross modes.
 
     def _persist_key(self, key: bytes) -> str:
-        return f"secret-hitv:{self.ruleset_fingerprint.hex()}:{key.hex()}"
+        return f"secret-hitv2:{self.ruleset_fingerprint.hex()}:{key.hex()}"
 
-    def _hit_get(self, key: bytes) -> tuple[int, ...] | None:
-        """Cached per-rule hit vector for a row digest, or None."""
+    def _hit_get(self, key: bytes):
+        """Cached row verdict for a row digest, or None."""
         with self._hit_lock:
             v = self._hit_lru.get(key)
             if v is not None:
@@ -406,7 +497,13 @@ class TpuSecretScanner:
         if self._hit_persist is not None:
             blob = self._hit_persist.get_blob(self._persist_key(key))
             if blob is not None:
-                v = tuple(blob["r"])
+                lic = blob.get("l")
+                v = (
+                    tuple(blob["r"]),
+                    tuple(blob.get("c", ())),
+                    bool(blob.get("n", 1)),
+                    None if lic is None else tuple(lic),
+                )
                 self._lru_insert(key, v)
                 return v
         return None
@@ -417,25 +514,65 @@ class TpuSecretScanner:
         with self._hit_lock:
             self._hit_lru.clear()
 
-    def _lru_insert(self, key: bytes, hit_rules: tuple[int, ...]) -> None:
+    def _lru_insert(self, key: bytes, verdict) -> None:
         """Insert under the entry bound — every LRU write path must evict,
         or persisted-cache re-scans of large corpora grow RSS unboundedly."""
         with self._hit_lock:
-            self._hit_lru[key] = hit_rules
+            self._hit_lru[key] = verdict
             self._hit_lru.move_to_end(key)
             while len(self._hit_lru) > self._hit_lru_max:
                 self._hit_lru.popitem(last=False)
 
-    def _hit_put(self, key: bytes, hit_rules: tuple[int, ...]) -> None:
-        self._lru_insert(key, hit_rules)
+    def _hit_put(self, key: bytes, verdict) -> None:
+        self._lru_insert(key, verdict)
         if self._hit_persist is not None:
+            hit_rules, cand_rules, nfa_ran, lic = verdict
             self._hit_persist.put_blob(
-                self._persist_key(key), {"r": list(hit_rules)}
+                self._persist_key(key),
+                {
+                    "r": list(hit_rules),
+                    "c": list(cand_rules),
+                    "n": int(nfa_ran),
+                    "l": lic if lic is None else list(lic),
+                },
             )
 
     # -- async feed pipeline ------------------------------------------------
 
-    def scan_files(self, files: Iterable[tuple[str, bytes]]) -> Iterator[Secret]:
+    def warm_buckets(self) -> None:
+        """Compile every (bucket shape × stream × stage) combination
+        outside any timed region — put + prefilter + match (+ the license
+        gram gate, when registered) per rung, so the first real batch
+        never pays a compile."""
+        stages = ["match"]
+        if self._staged.has_stage("prefilter"):
+            stages.insert(0, "prefilter")
+        if self._staged.has_stage("license"):
+            stages.append("license")
+        for b in self._buckets:
+            for _ in range(max(1, self._staged.n_streams)):
+                dev, didx = self._staged.put(
+                    np.zeros((b, self.chunk_len), dtype=np.uint8)
+                )
+                for name in stages:
+                    np.asarray(self._staged.run(name, dev, didx))
+
+    def _ensure_license_stage(self) -> None:
+        """Register the license gram-gate kernel as a fused stage (once per
+        scanner; the jitted gate itself is process-cached per chunk_len).
+        Output is per-BLOCK ([B, chunk_len/block]) so packed-row segments
+        resolve to their own verdicts."""
+        with self._stage_lock:
+            if not self._staged.has_stage("license"):
+                from trivy_tpu.licensing.fused import get_gate_fn
+
+                fn = get_gate_fn(self.chunk_len)
+                self._lic_block = fn.block
+                self._staged.add_stage("license", fn, out_axes=2)
+
+    def scan_files(
+        self, files: Iterable[tuple[str, bytes]], license_gate=None
+    ) -> Iterator[Secret]:
         """Scan many files; yields per-file results in input order.
 
         The input iterable is consumed on a dedicated feeder thread, so a
@@ -443,8 +580,17 @@ class TpuSecretScanner:
         confirmation) never stalls chunking, hashing, or device transfers
         — backpressure comes only from the bounded arena, dispatch queue,
         and confirm semaphore. See :class:`_ScanRun` for the pipeline.
+
+        ``license_gate`` (a :class:`trivy_tpu.licensing.fused.
+        FusedLicenseGate`) opts this scan into the shared-arena fused pass:
+        the license gram gate runs over the same resident rows and the
+        gate accumulates per-file candidate verdicts for the license
+        analyzer — each scanned byte crosses the link once for both
+        detectors.
         """
-        run = _ScanRun(self, files, obs.current())
+        if license_gate is not None:
+            self._ensure_license_stage()
+        run = _ScanRun(self, files, obs.current(), license_gate)
         run.start()
         try:
             next_emit = 0
@@ -485,20 +631,50 @@ class TpuSecretScanner:
         return self._confirm_inner(st, prof)
 
     def _confirm_inner(self, st: _FileState, prof=None) -> Secret:
+        from trivy_tpu.secret.rules import ascii_lower
+
         windows_by_id = {
             self.compiled.rule_ids[i]: w for i, w in st.rules.items()
         }
         host_ids = set(self.compiled.host_rule_ids)
-        if not windows_by_id and not host_ids:
+        cand_ids: set[str] | None = None
+        unchecked_ids: set[str] = set()
+        extra_ids: set[str] = set()
+        if st.cand is not None:
+            rid = self.compiled.rule_ids
+            cand_ids = {rid[i] for i in st.cand}
+            unchecked_ids = {rid[i] for i in st.unchecked}
+            # guarded anchored rules that are file-level candidates but
+            # whose kernel was skipped for some chunk may have recorded no
+            # window at all — they still need a (full-scan) confirmation
+            extra_ids = (unchecked_ids & cand_ids) - set(windows_by_id)
+        if not windows_by_id and not host_ids and not extra_ids:
             return Secret(file_path=st.path)
         content = st.data.decode("latin-1")
-        lower = content.lower()
+        lower = ascii_lower(content)
         global_blocks = self.exact.global_block_spans(content)
         hits = []
         for rule in self.exact.rules_for_path(st.path):
+            if (
+                cand_ids is not None
+                and rule.id in self._guarded_ids
+                and rule.id not in cand_ids
+            ):
+                # no keyword of this rule occurs anywhere in the file: the
+                # exact engine's match_keywords would reject it, so the
+                # confirm (and its wasted_confirm cost) is skipped outright
+                # — this is the prefilter's answer to the PR 5 fp_rate rows
+                continue
             t0 = time.perf_counter() if prof is not None else 0.0
             if rule.id in windows_by_id:
-                if rule.id in self._windowed_ids:
+                if rule.id in unchecked_ids:
+                    # some chunk of this file never ran the rule's anchored
+                    # kernel (its batch was prefilter-skipped): windows may
+                    # be incomplete, so fall back to the full-content scan
+                    locs = self.exact.find_rule_locations_fullscan(
+                        rule, content, lower, global_blocks
+                    )
+                elif rule.id in self._windowed_ids:
                     # regex runs only around the device-flagged chunk windows
                     locs = self.exact.find_rule_locations_in_windows(
                         rule, content, lower, windows_by_id[rule.id], global_blocks
@@ -510,6 +686,10 @@ class TpuSecretScanner:
                     locs = self.exact.find_rule_locations_fullscan(
                         rule, content, lower, global_blocks
                     )
+            elif rule.id in extra_ids:
+                locs = self.exact.find_rule_locations_fullscan(
+                    rule, content, lower, global_blocks
+                )
             elif rule.id in host_ids:
                 locs = self.exact.find_rule_locations(
                     rule, content, lower, global_blocks
@@ -557,7 +737,7 @@ class _ScanRun:
     ``host_fallback=False``, :meth:`_fail` so the generator re-raises.
     """
 
-    def __init__(self, sc: TpuSecretScanner, files, ctx):
+    def __init__(self, sc: TpuSecretScanner, files, ctx, license_gate=None):
         self.sc = sc
         self.files = files
         self.ctx = ctx
@@ -566,6 +746,10 @@ class _ScanRun:
         self.lock = threading.Lock()
         self.cond = threading.Condition(self.lock)
         self.states: dict[int, _FileState] = {}
+        # fused license pass: per-scan candidate gate + fidx -> query path
+        # for files the license analyzer will ask about
+        self.lic_gate = license_gate
+        self.lic_paths: dict[int, str] = {}
         # reorder buffer: input index -> Secret | in-flight Future
         self.results: dict[int, Secret | Future] = {}
         # row digest -> waiting segment lists: identical rows already
@@ -667,6 +851,8 @@ class _ScanRun:
                 self.error = err
             self.cond.notify_all()
         self.stop.set()
+        if self.lic_gate is not None:
+            self.lic_gate.degrade()
 
     def _degrade(self, cause: BaseException) -> None:
         """Last rung: move every file with unresolved device work onto the
@@ -679,6 +865,10 @@ class _ScanRun:
             self.degraded = True
             moved = [(i, self.states.pop(i)) for i in sorted(self.states)]
             self.row_waiters.clear()
+        if self.lic_gate is not None:
+            # device verdicts are incomplete from here on: the license
+            # analyzer must classify everything it collected
+            self.lic_gate.degrade()
         self.sc._note_degraded(self.ctx, cause)
         for fidx, st in moved:
             self._submit_host(fidx, st.path, st.data)
@@ -724,31 +914,80 @@ class _ScanRun:
             return
         self._set_result(fidx, self.pool.submit(self._host_task, path, data))
 
+    def _apply_lic(self, segs, lic) -> None:
+        """Fold one row's fused license verdict into the gate. ``lic`` is
+        a tuple of hit BLOCK indices from the gram gate (usually empty),
+        or None when the row's batch never ran it — a wanted file
+        replaying an ungated cached row falls back to exact classification
+        (gate.skip), never to a silent miss.
+
+        Segment row-offsets are reconstructed from the packing layout
+        (cumulative ``len + gap``, exactly how emit_pack laid them out) so
+        a hit block flags only the file(s) it overlaps."""
+        gate = self.lic_gate
+        if gate is None:
+            return
+        if lic is None:
+            for fidx, _, _ in segs:
+                path = self.lic_paths.get(fidx)
+                if path is not None:
+                    gate.skip(path)
+            return
+        if not lic:
+            return
+        blk = self.sc._lic_block
+        gap = self.sc.overlap
+        chunk_len = self.sc.chunk_len
+        off = 0
+        for i, (fidx, ws, we) in enumerate(segs):
+            seg_len = we - ws
+            if len(segs) == 1:
+                lo, hi = 0, chunk_len  # whole-row segment (big-file chunk)
+            else:
+                lo, hi = off, off + seg_len
+                off += seg_len + gap
+            path = self.lic_paths.get(fidx)
+            if path is None:
+                continue
+            # a hit block overlapping [lo, hi) flags this segment's file;
+            # boundary-straddling blocks flag both neighbors (FP-only)
+            if any(b * blk < hi and (b + 1) * blk > lo for b in lic):
+                gate.flag(path)
+
     def _apply_hits(self, batch: list) -> None:
         """Credit resolved rows to their file segments; ``batch`` is
-        ``[(segs, hit_rules)]``. Every row hit applies to every segment —
-        cross-segment false candidates are discarded by the exact confirm.
-        Files whose last pending row resolved here go to the confirm pool
-        (the semaphore is taken OUTSIDE the pipeline lock so a full
-        confirm queue stalls only the calling thread, not resolution
-        bookkeeping on other streams)."""
+        ``[(segs, hit_rules, cand_rules, nfa_ran, lic)]`` (the row-verdict
+        schema of the dedup cache). Every row hit applies to every segment
+        — cross-segment false candidates are discarded by the exact
+        confirm. Files whose last pending row resolved here go to the
+        confirm pool (the semaphore is taken OUTSIDE the pipeline lock so
+        a full confirm queue stalls only the calling thread, not
+        resolution bookkeeping on other streams)."""
+        sc = self.sc
         prof = self.prof
         if prof is not None:
-            rule_ids = self.sc.compiled.rule_ids
-            for _, hit_rules in batch:
+            rule_ids = sc.compiled.rule_ids
+            for _, hit_rules, cand_rules, _, _ in batch:
                 # one logical device hit per (row, rule) — dedup-cache and
                 # coalesced rows count too: they cost a confirm all the same
                 for r in hit_rules:
                     prof.gate_hit(rule_ids[r])
+                for r in cand_rules:
+                    prof.prefilter_hit(rule_ids[r])
+        guarded_anchored = sc._guarded_anchored
         ready: list[tuple[int, _FileState]] = []
         with self.lock:
-            for segs, hit_rules in batch:
+            for segs, hit_rules, cand_rules, nfa_ran, _ in batch:
                 for fidx, ws, we in segs:
                     st = self.states.get(fidx)
                     if st is None:
                         continue  # already moved to the host path
                     for r in hit_rules:
                         st.rules.setdefault(r, []).append((ws, we))
+                    if st.cand is not None:
+                        st.cand.update(cand_rules)
+                        if not nfa_ran:
+                            st.unchecked.update(guarded_anchored)
                 for fidx, _, _ in segs:
                     st = self.states.get(fidx)
                     if st is None:
@@ -757,57 +996,122 @@ class _ScanRun:
                     if st.pending == 0:
                         del self.states[fidx]
                         ready.append((fidx, st))
+        for segs, _, _, _, lic in batch:
+            self._apply_lic(segs, lic)
         for fidx, st in ready:
             self._submit_confirm(fidx, st)
 
-    def _resolve(self, batch_hits: np.ndarray, batch_meta: list) -> None:
-        # one vectorized nonzero per batch, not one per row; rows past
-        # len(batch_meta) are bucket padding and are sliced off here
-        rows, ridx = np.nonzero(batch_hits[: len(batch_meta)])
+    def _resolve(
+        self,
+        batch_hits: np.ndarray | None,
+        batch_meta: list,
+        pre: np.ndarray | None = None,
+        lic_arr: np.ndarray | None = None,
+        nfa_ran: bool = True,
+        lic_ran: bool = False,
+    ) -> None:
+        """Fold one fetched batch into file state. ``batch_hits`` is the
+        anchored/full matcher output (None when the batch skipped it),
+        ``pre`` the prefilter candidate mask, ``lic_arr`` the license gate
+        flags; all sliced to the live rows here (rows past
+        ``len(batch_meta)`` are bucket padding)."""
+        sc = self.sc
+        n = len(batch_meta)
         by_row: dict[int, list[int]] = {}
+        cand_by_row: dict[int, list[int]] = {}
+        if pre is not None:
+            pre = np.asarray(pre[:n], dtype=bool)
+            rows, ridx = np.nonzero(pre)
+            for row, r in zip(rows.tolist(), ridx.tolist()):
+                cand_by_row.setdefault(row, []).append(r)
+            # keyword-lane hits come straight from the prefilter mask (the
+            # matcher no longer carries that lane); anchored hits from the
+            # matcher when it ran
+            kw_cols = sc._kw_lane_cols
+            hits = (
+                np.array(batch_hits[:n], dtype=bool, copy=True)
+                if batch_hits is not None
+                else np.zeros((n, sc.compiled.num_rules), dtype=bool)
+            )
+            if len(kw_cols):
+                hits[:, kw_cols] |= pre[:, kw_cols]
+            hit_rows = int(pre.any(axis=1).sum())
+            sc.stats.add(rows_prefiltered=n, rows_prefilter_hit=hit_rows)
+            if self.prof is not None:
+                self.prof.prefilter_rows(n, 0 if nfa_ran else n, hit_rows)
+        else:
+            hits = np.asarray(batch_hits[:n])
+        rows, ridx = np.nonzero(hits)
         for row, r in zip(rows.tolist(), ridx.tolist()):
             by_row.setdefault(row, []).append(r)
+        lic_by_row: dict[int, tuple[int, ...]] = {}
+        if lic_ran and lic_arr is not None:
+            lic_arr = np.asarray(lic_arr[:n], dtype=bool)
+            rows, blks = np.nonzero(lic_arr)
+            for row, b in zip(rows.tolist(), blks.tolist()):
+                lic_by_row.setdefault(row, ())
+                lic_by_row[row] = lic_by_row[row] + (b,)
+            sc.stats.add(
+                license_rows_gated=n,
+                license_rows_flagged=int(lic_arr.any(axis=1).sum()),
+            )
         apply: list = []
         for row, (key, segs) in enumerate(batch_meta):
             hit_rules = tuple(by_row.get(row, ()))
-            apply.append((segs, hit_rules))
+            cand_rules = tuple(cand_by_row.get(row, ()))
+            lic = lic_by_row.get(row, ()) if lic_ran else None
+            verdict = (hit_rules, cand_rules, nfa_ran, lic)
+            apply.append((segs,) + verdict)
             if key is not None:
-                self.sc._hit_put(key, hit_rules)
+                self.sc._hit_put(key, verdict)
                 with self.lock:
                     waiting = self.row_waiters.pop(key, ())
                 for w in waiting:
-                    apply.append((w, hit_rules))
+                    apply.append((w,) + verdict)
         self._apply_hits(apply)
 
     # -- transfer-stream workers --------------------------------------------
 
     def _worker(self, wid: int) -> None:
-        """One transfer stream: dispatch slabs asynchronously, keep a
-        bounded in-flight window (double buffering), fetch the oldest,
-        resolve inline. Per-batch failure ladder as in README
-        "Robustness": re-dispatch up to ``batch_retries`` times (under
-        round-robin the retry lands on the next healthy device and the
-        breaker hears about it), OOM-shaped errors split the batch in
-        half, and only an exhausted ladder (or every device
-        circuit-broken) escalates to the scan-level host fallback.
+        """One transfer stream: place slabs once, run the fused device
+        stages against the resident rows, keep a bounded in-flight window
+        (double buffering), fetch the oldest, resolve inline.
+
+        Per-batch staging: the PREFILTER (and, when fused, the license
+        gram gate) dispatches immediately with the upload; its fetch is
+        the batch's first sync point and decides whether the anchored
+        matcher runs at all — a batch with no candidate for any anchored
+        rule (and no unguarded anchored rules in the ruleset) resolves
+        from the prefilter mask alone, skipping the expensive kernel AND
+        its host confirms. The slab releases only after the LAST stage
+        reading the resident input has fetched (device_put may alias host
+        memory on the CPU backend — an earlier release would let the
+        feeder refill bytes a later-stage kernel still reads).
+
+        Per-batch failure ladder as in README "Robustness": re-dispatch up
+        to ``batch_retries`` times (under round-robin the retry lands on
+        the next healthy device and the breaker hears about it),
+        OOM-shaped errors split the batch in half, and only an exhausted
+        ladder (or every device circuit-broken) escalates to the
+        scan-level host fallback.
 
         Stall instrumentation (all on the spawning scan's context):
         ``secret.feed_wait`` is time blocked on the host feed
         (feed-starved), ``secret.dispatch`` the enqueue/transfer handoff
-        (upload-bound), ``secret.device_wait`` the blocking result fetch
-        (device-bound)."""
+        (upload-bound), ``secret.prefilter`` the prefilter fetch,
+        ``secret.device_wait`` the blocking matcher fetch (device-bound)."""
         from trivy_tpu.parallel.mesh import DevicesUnavailable
 
         sc = self.sc
         ctx = self.ctx
-        match = sc._match
-        dispatch_fn = match.dispatch
-        record = getattr(match, "record_result", None)
+        staged = sc._staged
+        use_pf = staged.has_stage("prefilter")
+        lic_gate = self.lic_gate
         prof = self.prof
         stats = sc.stats
         chunk_len = sc.chunk_len
-        # (dev, meta, batch, slab_id, device_idx, retries); slab_id is None
-        # for retry copies, which own their arrays outright
+        # (dev_input, meta, batch, slab_id, device_idx, retries, handles);
+        # slab_id is None for retry copies, which own their arrays outright
         pending: deque = deque()
 
         def rebatch(batch: np.ndarray, meta: list) -> np.ndarray:
@@ -859,29 +1163,80 @@ class _ScanRun:
                 self.arena.release(slab_id)
             raise _DeviceFailed(err)
 
+        def want_lic(meta) -> bool:
+            if lic_gate is None:
+                return False
+            lp = self.lic_paths
+            return any(
+                fidx in lp for _, segs in meta for fidx, _, _ in segs
+            )
+
         def dispatch_batch(batch, meta, slab_id, retries) -> None:
             work = [(batch, meta, slab_id, retries)]
             while work:
                 b, m, sid, r = work.pop()
                 try:
                     with ctx.span("secret.dispatch"):
-                        dev, didx = dispatch_fn(b)
+                        dev, didx = staged.put(b)
+                        h: dict = {}
+                        if use_pf:
+                            h["pre"] = staged.run("prefilter", dev, didx)
+                        else:
+                            h["match"] = staged.run("match", dev, didx)
+                        if want_lic(m):
+                            h["lic"] = staged.run("license", dev, didx)
                 except Exception as e:
                     # dispatch-time failure (breaker already notified by
-                    # the round-robin wrapper); walk the ladder
+                    # the placement layer); walk the ladder
                     work.extend(recover(b, m, sid, r, e))
                     continue
-                pending.append((dev, m, b, sid, didx, r))
+                pending.append((dev, m, b, sid, didx, r, h))
 
         def fetch_oldest() -> None:
-            dev, meta, batch, sid, didx, retries = pending.popleft()
+            dev, meta, batch, sid, didx, retries, h = pending.popleft()
             try:
                 faults.check(
                     "device.fetch", key=f"d{didx if didx is not None else 0}"
                 )
                 t0 = time.perf_counter() if prof is not None else 0.0
-                with ctx.span("secret.device_wait"):
-                    arr = np.asarray(dev)
+                pre = None
+                arr = None
+                nfa_ran = True
+                if use_pf:
+                    with ctx.span("secret.prefilter"):
+                        pre = np.asarray(h["pre"])
+                    live = pre[: len(meta)]
+                    need_nfa = sc._has_unguarded_anchored or bool(
+                        live[:, sc._guarded_anchored_cols].any()
+                        if len(sc._guarded_anchored_cols)
+                        else False
+                    )
+                    if need_nfa:
+                        with ctx.span("secret.dispatch"):
+                            mh = staged.run("match", dev, didx)
+                        with ctx.span("secret.device_wait"):
+                            arr = np.asarray(mh)
+                    else:
+                        nfa_ran = False
+                        stats.add(
+                            rows_nfa_skipped=len(meta),
+                            batches_nfa_skipped=1,
+                        )
+                        if self.enabled:
+                            ctx.count("secret.rows_nfa_skipped", len(meta))
+                else:
+                    with ctx.span("secret.device_wait"):
+                        arr = np.asarray(h["match"])
+                lic_ran = "lic" in h
+                lic_arr = np.asarray(h["lic"]) if lic_ran else None
+                # every stage that reads the resident input has now fetched
+                # — only here is the slab provably free of zero-copy device
+                # views (jax.device_put may ALIAS host memory on the CPU
+                # backend, so "the transfer finished" is not enough while a
+                # later-stage kernel could still read the input)
+                if sid is not None:
+                    self.arena.release(sid)
+                    sid = None
                 if prof is not None:
                     # per-bucket dispatch cost: the bucket is the padded
                     # batch shape (the compile-once ladder rung), rows are
@@ -890,23 +1245,22 @@ class _ScanRun:
                         batch.shape[0], len(meta), time.perf_counter() - t0
                     )
             except Exception as e:
-                if record is not None and didx is not None:
-                    record(didx, False)
+                staged.record_result(didx, False)
                 for item in recover(batch, meta, sid, retries, e):
                     dispatch_batch(*item)
                 return
-            if record is not None and didx is not None:
-                record(didx, True)
+            staged.record_result(didx, True)
             if sid is not None:
-                # the fetch proves the transfer finished: the slab can be
-                # refilled without aliasing a zero-copy device view
                 self.arena.release(sid)
             if not self.degraded:
-                self._resolve(arr, meta)
+                self._resolve(
+                    arr, meta, pre=pre, lic_arr=lic_arr,
+                    nfa_ran=nfa_ran, lic_ran=lic_ran,
+                )
 
         def release_pending() -> None:
             while pending:
-                _, _, _, sid, _, _ = pending.popleft()
+                _, _, _, sid, _, _, _ = pending.popleft()
                 if sid is not None:
                     self.arena.release(sid)
 
@@ -961,10 +1315,36 @@ class _ScanRun:
         chunk_len = sc.chunk_len
         B = sc.batch_size
         dedup = sc._dedup
-        fp_key = sc.ruleset_fingerprint
+        # fused-license scans use a disjoint digest namespace: their cached
+        # row verdicts carry a license-gate bit that plain scans never set
+        fp_key = (
+            sc.ruleset_fingerprint
+            if self.lic_gate is None
+            else sc.ruleset_fingerprint + b":lic"
+        )
+        use_pf = sc.prefilter_on
+        lic_gate = self.lic_gate
+        # widest gram/anchor byte window the device gate provably sees
+        # interior to some chunk (licensing/fused.py host patch covers the
+        # rest)
+        lic_span_bound = sc.overlap - 2
         gap = sc.overlap
         pack_max = chunk_len - gap
         blake2b = hashlib.blake2b
+
+        def lic_register(fidx: int, path: str, data: bytes) -> None:
+            """Fused pass bookkeeping for one file entering the device
+            feed: coverage + the host wide-window patch, and the fidx ->
+            path mapping row resolution flags against."""
+            if lic_gate is not None and lic_gate.wants(path):
+                self.lic_paths[fidx] = path
+                lic_gate.feed_file(path, data, lic_span_bound)
+
+        def lic_skip(path: str) -> None:
+            """This path's bytes will not (all) ride the device pass —
+            the license analyzer must classify it itself."""
+            if lic_gate is not None and lic_gate.wants(path):
+                lic_gate.skip(path)
 
         slab_id: int | None = None
         slab: np.ndarray | None = None
@@ -1021,7 +1401,7 @@ class _ScanRun:
                 stats.add(chunks_dedup_hit=1, bytes_dedup_hit=nbytes)
                 if enabled:
                     ctx.count("secret.bytes_dedup_hit", nbytes)
-                self._apply_hits([(segs, cached)])
+                self._apply_hits([(segs,) + cached])
                 return True
             with self.lock:
                 waiting = self.row_waiters.get(key)
@@ -1126,10 +1506,16 @@ class _ScanRun:
             nonlocal used, copy_win
             starts = chunk_spans(len(data), chunk_len, sc.overlap)
             if not register_state(
-                fidx, _FileState(path=path, data=data, pending=len(starts))
+                fidx,
+                _FileState(
+                    path=path, data=data, pending=len(starts),
+                    cand=set() if use_pf else None,
+                ),
             ):
+                lic_skip(path)
                 self._submit_host(fidx, path, data)
                 return
+            lic_register(fidx, path, data)
             arr = np.frombuffer(data, dtype=np.uint8)
             n = arr.size
             stats.add(bytes_in=len(data), chunks=len(starts))
@@ -1175,6 +1561,7 @@ class _ScanRun:
                     # engine under the same confirm backpressure (files
                     # already swept by _degrade keep their host results)
                     pack_pending.clear()
+                    lic_skip(path)
                     self._submit_host(fidx, path, data)
                     continue
                 try:
@@ -1182,23 +1569,35 @@ class _ScanRun:
                         if sc.exact.allow_path(path):
                             # path-level global allowlist: skip the whole
                             # file (ref: scanner.go:388-392) — no device work
+                            lic_skip(path)
                             self._set_result(fidx, Secret(file_path=path))
                         elif not data:
                             # empty file: nothing for the device to match —
                             # resolve host-side immediately (host-lane rules
-                            # still run there)
+                            # still run there); zero bytes means the fused
+                            # license gate misses nothing either
+                            if lic_gate is not None and lic_gate.wants(path):
+                                lic_gate.cover(path)
                             self._submit_confirm(
                                 fidx,
-                                _FileState(path=path, data=data, pending=0),
+                                _FileState(
+                                    path=path, data=data, pending=0,
+                                    cand=set() if use_pf else None,
+                                ),
                             )
                         elif sc._pack_small and len(data) <= pack_max:
                             stats.add(bytes_in=len(data))
                             if register_state(
                                 fidx,
-                                _FileState(path=path, data=data, pending=1),
+                                _FileState(
+                                    path=path, data=data, pending=1,
+                                    cand=set() if use_pf else None,
+                                ),
                             ):
+                                lic_register(fidx, path, data)
                                 add_small(fidx, data)
                             else:
+                                lic_skip(path)
                                 self._submit_host(fidx, path, data)
                         else:
                             feed_big(fidx, path, data)
