@@ -230,15 +230,25 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=None):
     warm_buckets(scanner)
     total_bytes = sum(len(d) for _, d in files)
 
-    def one_rep(enabled):
+    def one_rep(enabled, telemetry=False):
+        from trivy_tpu.obs import timeseries as obs_timeseries
+
         scanner.clear_hit_cache()
         s0 = scanner.stats.snapshot()
         with obs.scan_context(name="bench-e2e", enabled=enabled) as ctx:
+            # telemetry sampler only on the explicitly-telemetered rep:
+            # headline reps stay sampler-free (zero-cost-when-off is the
+            # r04->r05 lesson, enforced by --smoke)
+            sampler = (
+                obs_timeseries.start_sampler(ctx, 0.05) if telemetry else None
+            )
             t0 = time.perf_counter()
             n_findings = sum(
                 len(s.findings) for s in scanner.scan_files(files)
             )
             dt = time.perf_counter() - t0
+            if sampler is not None:
+                sampler.stop()
         s1 = scanner.stats.snapshot()
         mbs = total_bytes / dt / (1024 * 1024)
         uploaded = s1["bytes_uploaded"] - s0["bytes_uploaded"]
@@ -288,11 +298,31 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=None):
         reps_out.append(rep_doc)
         link = link_after
     # the traced rep: stall verdict + per-rule/per-bucket profile for the
-    # BENCH json, and the measured tracing overhead vs the untraced median
-    tr = one_rep(enabled=True)
+    # BENCH json, and the measured tracing overhead vs the untraced median.
+    # It also carries the live-telemetry sampler, whose series yield the
+    # utilization metrics --check-regression guards (link_mbs_p50/p95,
+    # device_busy_ratio)
+    tr = one_rep(enabled=True, telemetry=True)
     m = obs_export.metrics_dict(tr["ctx"])
     prof = m.get("profile") or {}
     med = median([r["e2e_mbs"] for r in reps_out])
+    # utilization stats come from the metrics doc's per-series summary —
+    # the same aggregation --metrics-out ships, so the two can't drift
+    tsum = m.get("timeseries") or {}
+    link = tsum.get("secret.link_mbs") or {}
+    busy_means = [
+        s["mean"] for name, s in tsum.items()
+        if name.startswith("device.") and name.endswith(".busy_ratio")
+    ]
+    telemetry = {
+        "samples": int(link.get("count", 0)),
+        "link_mbs_p50": round(link.get("p50", 0.0), 2),
+        "link_mbs_p95": round(link.get("p95", 0.0), 2),
+        "device_busy_ratio": round(
+            sum(busy_means) / len(busy_means), 4
+        ) if busy_means else 0.0,
+        "devices": len(busy_means),
+    }
     traced = {
         "e2e_mbs": round(tr["mbs"], 2),
         "overhead_vs_median_pct": round(100.0 * (1 - tr["mbs"] / med), 1)
@@ -302,6 +332,7 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=None):
         "stage_p95_ms": {
             name: round(s["p95"] * 1e3, 3) for name, s in m["spans"].items()
         },
+        "telemetry": telemetry,
         # per-rule / per-bucket cost attribution (rules are cost-ordered;
         # top 10 keeps the rep readable — the full set rides --profile-out
         # on real scans)
@@ -868,6 +899,72 @@ SMOKE_STAGES = (
     "secret.confirm",
 )
 
+# counter tracks the traced smoke rep must record (the acceptance set:
+# link MB/s, arena occupancy, queue depth, per-device busy)
+SMOKE_COUNTER_TRACKS = (
+    "secret.link_mbs",
+    "secret.arena_free_slabs",
+    "secret.feed_queue_depth",
+    "device.d0.busy_ratio",
+)
+
+# sampler overhead bound on untraced reps (pct of median throughput): the
+# r04->r05 regression was always-on instrumentation on the hot path; the
+# sampler must stay a parked thread that untraced scans never spawn
+SMOKE_TELEMETRY_OVERHEAD_PCT = 1.0
+
+
+def _telemetry_overhead(scanner, files) -> tuple[float, list[str]]:
+    """Untraced rep time with and without the telemetry sampler at its
+    default cadence: returns (overhead_pct, thread names observed mid-rep
+    with telemetry OFF). Headline reps run telemetry-off, so any
+    'telemetry-sampler' thread in that list is the always-on regression
+    recurring. Best-of-3 per arm, interleaved, and a failing measurement
+    re-runs once keeping the smaller value — small-corpus reps carry a few
+    percent of one-sided timing noise, and only a *persistent* overhead is
+    a real always-on cost."""
+    import threading
+
+    from trivy_tpu import obs
+    from trivy_tpu.obs import timeseries as obs_timeseries
+
+    off_threads: list[str] = []
+
+    def rep(telemetry: bool) -> float:
+        scanner.clear_hit_cache()
+        with obs.scan_context(name="smoke-overhead", enabled=False) as ctx:
+            sampler = (
+                obs_timeseries.start_sampler(ctx) if telemetry else None
+            )
+            t0 = time.perf_counter()
+            gen = scanner.scan_files(files)
+            next(gen, None)  # mid-flight: the pipeline threads are live
+            if not telemetry:
+                off_threads.extend(
+                    t.name for t in threading.enumerate()
+                    if t.name.startswith("telemetry-sampler")
+                )
+            for _ in gen:
+                pass
+            dt = time.perf_counter() - t0
+            if sampler is not None:
+                sampler.stop()
+        return dt
+
+    def measure() -> float:
+        base, tele = [], []
+        for _ in range(3):  # interleaved so machine drift hits both arms
+            base.append(rep(False))
+            tele.append(rep(True))
+        return 100.0 * (min(tele) / min(base) - 1.0)
+
+    overhead = measure()
+    for _ in range(2):  # re-measure only failures: noise is one-sided
+        if overhead <= SMOKE_TELEMETRY_OVERHEAD_PCT:
+            break
+        overhead = min(overhead, measure())
+    return overhead, sorted(set(off_threads))
+
 
 def _smoke_client_mode() -> tuple[list[str], dict, str]:
     """Client-mode traced rep against an in-process server: returns the
@@ -926,10 +1023,14 @@ def smoke(trace_out=None, metrics_out=None) -> int:
         (f"smoke/small_{i}.txt", bytes(rng.integers(32, 127, 512, np.uint8)))
         for i in range(8)
     ]
+    from trivy_tpu.obs import timeseries as obs_timeseries
+
     warm_buckets(scanner)
     s0 = scanner.stats.snapshot()
     with obs.scan_context(name="bench-smoke", enabled=True) as ctx:
+        sampler = obs_timeseries.start_sampler(ctx, 0.05)
         n_findings = sum(len(s.findings) for s in scanner.scan_files(files))
+        sampler.stop()
     s1 = scanner.stats.snapshot()
     if trace_out:
         obs_export.write_chrome_trace(ctx, trace_out)
@@ -975,6 +1076,49 @@ def smoke(trace_out=None, metrics_out=None) -> int:
             file=sys.stderr,
         )
         return 1
+    # telemetry gates: the traced rep's counter tracks must exist and be
+    # non-empty, and cumulative counters must never decrease (a reset or
+    # double-accounting bug would silently corrupt every derived rate)
+    ts = ctx.timeseries
+    empty = [
+        n for n in SMOKE_COUNTER_TRACKS
+        if ts is None or not ts.values(n)
+    ]
+    if empty:
+        print(
+            f"FATAL: traced rep's counter track(s) are empty: {empty} "
+            f"(recorded: {ts.names() if ts is not None else []})",
+            file=sys.stderr,
+        )
+        return 1
+    for name in ts.names():
+        if not name.endswith("_total"):
+            continue
+        vals = ts.values(name)
+        if any(b < a for a, b in zip(vals, vals[1:])):
+            print(
+                f"FATAL: monotonic counter series {name} decreased "
+                f"mid-scan (telemetry accounting went backwards)",
+                file=sys.stderr,
+            )
+            return 1
+    overhead_pct, off_threads = _telemetry_overhead(scanner, files)
+    if off_threads:
+        print(
+            f"FATAL: sampler thread(s) {off_threads} were live during an "
+            f"untraced rep — telemetry must be zero-cost-when-off "
+            f"(the r04->r05 always-on-profiling regression recurring)",
+            file=sys.stderr,
+        )
+        return 1
+    if overhead_pct > SMOKE_TELEMETRY_OVERHEAD_PCT:
+        print(
+            f"FATAL: telemetry sampler overhead {overhead_pct:.2f}% exceeds "
+            f"the {SMOKE_TELEMETRY_OVERHEAD_PCT:.0f}% bound on untraced "
+            f"headline-style reps",
+            file=sys.stderr,
+        )
+        return 1
     server_stages, client_profile, client_trace_id = _smoke_client_mode()
     if not server_stages:
         print(
@@ -998,6 +1142,8 @@ def smoke(trace_out=None, metrics_out=None) -> int:
                 "stall": stall.attribution(ctx),
                 "prefilter_selectivity": round(selectivity, 4),
                 "profile_rules": len(profile["rules"]),
+                "counter_tracks": ts.names(),
+                "sampler_overhead_pct": round(overhead_pct, 2),
                 "client_mode": {
                     "trace_id": client_trace_id,
                     "server_stages": server_stages,
@@ -1017,6 +1163,14 @@ REGRESSION_THRESHOLD = 0.15
 # metrics where UP is the regression direction (link cost per scanned
 # byte): a >threshold RISE fails exactly like a throughput drop
 LOWER_IS_BETTER = {"device_bytes_uploaded_per_scanned_byte"}
+
+# utilization telemetry (sampled during the traced rep): a drop here fails
+# the gate ONLY when the headline throughput also fell — with throughput
+# flat or up, lower link MB/s / busy fraction means the pipeline got MORE
+# efficient per byte (dedup, prefilter, packing wins), and an efficiency
+# improvement must not read as a regression. Link-byte cost itself is
+# separately guarded (lower-is-better) above.
+UTILIZATION_METRICS = {"link_mbs_p50", "link_mbs_p95", "device_busy_ratio"}
 
 
 def _load_bench_doc(path: str) -> dict:
@@ -1046,6 +1200,17 @@ def _metric_values(doc: dict) -> dict:
     out = {}
     if isinstance(doc.get("value"), (int, float)):
         out[doc["metric"]] = float(doc["value"])
+    # utilization telemetry rides the headline doc's detail (sampled by the
+    # traced rep); guard it alongside throughput — a run that keeps its
+    # MB/s but halves link utilization or device busy time is hiding a
+    # pipeline change the next round will pay for
+    # a genuine 0.0 must stay comparable — a collapse-to-zero is the worst
+    # regression, not an excuse to skip the check (zero PREVIOUS values are
+    # excused by check_regression's pv <= 0 guard)
+    for key in ("link_mbs_p50", "link_mbs_p95", "device_busy_ratio"):
+        v = (doc.get("detail") or {}).get(key)
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
     for m in (doc.get("detail") or {}).get("extra_metrics", []):
         if m.get("error"):
             continue
@@ -1086,6 +1251,9 @@ def check_regression(prev_path: str, cur_path: str,
         print(f"FATAL: {cur_path}: no secret_scan_e2e_throughput metric",
               file=sys.stderr)
         return 2
+    headline_fell = (
+        cur["secret_scan_e2e_throughput"] < prev["secret_scan_e2e_throughput"]
+    )
     rows = []
     regressions = []
     for name in sorted(prev):
@@ -1099,6 +1267,8 @@ def check_regression(prev_path: str, cur_path: str,
         bad = delta > threshold if name in LOWER_IS_BETTER else (
             delta < -threshold
         )
+        if bad and name in UTILIZATION_METRICS and not headline_fell:
+            bad = False  # efficiency win: less link/device per byte
         if bad:
             regressions.append((name, pv, cv, delta))
     # the auto-gate inside `python bench.py` reports on stderr so stdout
@@ -1219,6 +1389,12 @@ def main():
             "e2e_reps": e2e_reps,
             "e2e_traced_rep": traced,
             "stall": traced["stall"],
+            # live-telemetry utilization (sampled during the traced rep);
+            # lifted into --check-regression so a drop in link utilization
+            # or device busy fraction fails like a throughput drop
+            "link_mbs_p50": traced["telemetry"]["link_mbs_p50"],
+            "link_mbs_p95": traced["telemetry"]["link_mbs_p95"],
+            "device_busy_ratio": traced["telemetry"]["device_busy_ratio"],
             "e2e_corpus_mb": E2E_MB,
             "findings": n_findings,
             "per_chip_target_mbs": round(PER_CHIP_TARGET_MBS, 1),

@@ -32,9 +32,16 @@ def test_bench_smoke_records_all_declared_stages(tmp_path):
 
     assert set(SMOKE_STAGES) <= set(doc["stages"])
     assert sum(doc["stall"]["secret"].values()) == 100
-    # both exports landed and parse
+    # telemetry gates ran: the traced rep carried live counter tracks and
+    # the measured sampler overhead stayed under the smoke bound
+    from bench import SMOKE_COUNTER_TRACKS, SMOKE_TELEMETRY_OVERHEAD_PCT
+
+    assert set(SMOKE_COUNTER_TRACKS) <= set(doc["counter_tracks"])
+    assert doc["sampler_overhead_pct"] <= SMOKE_TELEMETRY_OVERHEAD_PCT
+    # both exports landed and parse; counter tracks render as "C" events
     trace_doc = json.loads(trace_out.read_text())
     assert any(e["ph"] == "X" for e in trace_doc["traceEvents"])
+    assert any(e["ph"] == "C" for e in trace_doc["traceEvents"])
     metrics_doc = json.loads(metrics_out.read_text())
     assert metrics_doc["spans"]["secret.dispatch"]["count"] >= 1
 

@@ -95,6 +95,11 @@ class LocalFSArtifact:
         n_files = 0
         n_analyzed = [0]  # mutable: read by the heartbeat thread
         ctx = obs.current()
+        # live scan progress (always-on, one add per file): bytes/files
+        # *walked* count at discovery, *scanned* once the analyzer loop has
+        # consumed the file — the denominator/numerator pair the telemetry
+        # sampler, heartbeat line, progress API, and --live all read
+        progress = ctx.progress()
 
         enabled = ctx.enabled
 
@@ -119,10 +124,12 @@ class LocalFSArtifact:
                 # TOCTOU: the file vanished (or turned unreadable) between
                 # the walk and the read — skip it, count it, keep scanning
                 note_file_skipped(rel, e)
+                progress.note_scanned(info.size)  # processed, even if skipped
                 return
             for t, content in wanted.items():
                 post_files.setdefault(t, {})[rel] = content
             n_analyzed[0] += 1
+            progress.note_scanned(info.size)
 
         # overlap file reads with analysis: a reader pool prefetches contents
         # ahead of the analyzer loop — the TPU-era equivalent of the
@@ -144,6 +151,7 @@ class LocalFSArtifact:
                 buffered = 0
                 for rel, info, opener in self.walker.walk(self.root):
                     n_files += 1
+                    progress.note_walked(info.size)
                     window.append((rel, info, pool.submit(opener)))
                     buffered += info.size
                     while (
@@ -153,6 +161,7 @@ class LocalFSArtifact:
                         r, i, fut = window.popleft()
                         buffered -= i.size
                         analyze(r, i, fut)
+                progress.finish_walk()
                 while window:
                     r, i, fut = window.popleft()
                     analyze(r, i, fut)

@@ -63,6 +63,20 @@ def global_flags() -> FlagGroup:
                       "hits, confirm time, false-positive rate, dispatch-"
                       "bucket timing) as JSON (implies span recording; "
                       ".gz path gzips)"),
+            Flag("telemetry-interval", default=None, value_type=float,
+                 config_name="telemetry.interval",
+                 help="live-telemetry sampling interval in seconds "
+                      "(default 0.25; 0 disables the sampler entirely)"),
+            Flag("timeseries-out", default=None,
+                 config_name="telemetry.timeseries-out",
+                 help="write the scan's live-telemetry time series (link "
+                      "MB/s, arena occupancy, queue depths, device busy, "
+                      "progress) as JSON (implies the sampler; .gz gzips)"),
+            Flag("live", default=False, value_type=bool,
+                 config_name="telemetry.live",
+                 help="print a live progress line (progress %, MB/s, ETA, "
+                      "device busy, arena occupancy) to stderr during the "
+                      "scan"),
             Flag("log-format", default="plain", choices=["plain", "json"],
                  config_name="log.format",
                  help="log line format: plain, or one JSON object per line"),

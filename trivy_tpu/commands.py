@@ -171,6 +171,21 @@ def run(command: str, ns, opts) -> int:
         opts.get("trace") or opts.get("trace_out")
         or opts.get("metrics_out") or opts.get("profile_out")
     )
+    from trivy_tpu.obs import timeseries as obs_timeseries
+
+    # live telemetry: the sampler thread spawns only when something will
+    # consume it (a trace/metrics export, --timeseries-out, or --live) AND
+    # the interval is nonzero — plain scans stay sampler-free, provably
+    # (bench --smoke asserts no sampler thread on untraced reps)
+    telemetry_interval = opts.get("telemetry_interval")
+    if telemetry_interval is None:
+        telemetry_interval = obs_timeseries.default_interval()
+    # the server command is excluded: ScanServer.scan runs one sampler per
+    # request — a process-lifetime sampler here would keep the shared
+    # gauges (and the live-sampler refcount) pinned while the fleet idles
+    telemetry_on = (
+        trace_on or bool(opts.get("timeseries_out")) or bool(opts.get("live"))
+    ) and telemetry_interval > 0 and command != "server"
     from trivy_tpu import faults
 
     # arm the fault-injection harness for this run (--fault-inject /
@@ -186,26 +201,40 @@ def run(command: str, ns, opts) -> int:
             "fault injection armed: %s", opts["fault_inject"]
         )
     with obs.scan_context(name=command, enabled=trace_on or None) as ctx:
+        sampler = (
+            obs_timeseries.start_sampler(ctx, telemetry_interval)
+            if telemetry_on
+            else None
+        )
+        live = (
+            obs_timeseries.LiveProgress(ctx).start()
+            if opts.get("live") and telemetry_on and command != "server"
+            else None
+        )
+        completed = False
         try:
             # validate the ignore policy up front: a broken policy file must
             # not cost the user a full scan before failing
             if opts.get("ignore_policy"):
                 IgnorePolicy(opts["ignore_policy"])
             if command in ("fs", "rootfs", "repo"):
-                return _run_fs_like(command, ns, opts)
-            if command == "image":
-                return _run_image(ns, opts)
-            if command == "vm":
-                return _run_vm(ns, opts)
-            if command == "sbom":
-                return _run_sbom(ns, opts)
-            if command == "convert":
-                return _run_convert(ns, opts)
-            if command == "server":
-                return _run_server(ns, opts)
-            if command == "clean":
-                return _run_clean(ns, opts)
-            raise ValueError(f"unknown command {command}")
+                rc = _run_fs_like(command, ns, opts)
+            elif command == "image":
+                rc = _run_image(ns, opts)
+            elif command == "vm":
+                rc = _run_vm(ns, opts)
+            elif command == "sbom":
+                rc = _run_sbom(ns, opts)
+            elif command == "convert":
+                rc = _run_convert(ns, opts)
+            elif command == "server":
+                rc = _run_server(ns, opts)
+            elif command == "clean":
+                rc = _run_clean(ns, opts)
+            else:
+                raise ValueError(f"unknown command {command}")
+            completed = True
+            return rc
         except TimeoutError as e:
             logger.error("%s", e)
             return 1
@@ -224,6 +253,26 @@ def run(command: str, ns, opts) -> int:
                 faults.clear()
             if timeout > 0 and command != "server":
                 signal.alarm(0)
+            # telemetry teardown runs on EVERY exit path (completion, scan
+            # death, timeout): stop the sampler (one final tick), then the
+            # live line — no leaked threads. Progress is marked finished
+            # only on real completion: a scan that died at 40% must export
+            # its last honest ratio, not a forced 1.0 (the rpc server's
+            # finished table follows the same rule)
+            if completed and ctx.progress_peek() is not None:
+                ctx.progress().finish()
+            if live is not None:
+                live.stop()
+            if sampler is not None:
+                sampler.stop()
+            if opts.get("timeseries_out"):
+                from trivy_tpu.obs import export
+
+                export.write_timeseries_json(ctx, opts["timeseries_out"])
+                logger.info(
+                    "telemetry time series written to %s",
+                    opts["timeseries_out"],
+                )
             if ctx.enabled:
                 from trivy_tpu.obs import export
 

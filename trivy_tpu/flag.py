@@ -90,6 +90,8 @@ class Flag:
             return str(raw).lower() in ("1", "true", "yes", "on")
         if self.value_type is int:
             return int(raw)
+        if self.value_type is float:
+            return float(raw)
         value = str(raw)
         if self.choices and value not in self.choices:
             raise ValueError(

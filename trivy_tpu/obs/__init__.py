@@ -222,6 +222,16 @@ class TraceContext:
         self.remote: list[dict] = []
         # per-rule / per-bucket cost profile, created lazily by profile()
         self._profile = None
+        # live telemetry (obs/timeseries.py): the scan's bounded time
+        # series, set by an attached Sampler; None on unsampled scans
+        self.timeseries = None
+        # always-on scan progress (bytes/files walked vs scanned), created
+        # lazily by progress() — like health, NOT gated on `enabled`
+        self._progress = None
+        # telemetry probes: cheap callables returning {series: value},
+        # registered by pipeline internals (feed arena, dispatch layer) and
+        # polled only while a sampler thread is attached
+        self._probes: list = []
         self._local = threading.local()
 
     # -- recording ----------------------------------------------------------
@@ -283,6 +293,52 @@ class TraceContext:
             if self._profile is None:
                 self._profile = ScanProfile()
             return self._profile
+
+    def progress(self):
+        """This scan's :class:`trivy_tpu.obs.timeseries.ScanProgress`,
+        created lazily. Always-on like the health channel: the progress
+        API and heartbeat must work on untraced scans, and the cost is a
+        lock + integer adds per file."""
+        from trivy_tpu.obs.timeseries import ScanProgress
+
+        with self._lock:
+            if self._progress is None:
+                self._progress = ScanProgress()
+            return self._progress
+
+    def progress_peek(self):
+        """The progress tracker if any producer created one, else None —
+        readers (heartbeat, sampler, --live) must not conjure an empty
+        tracker that would then report a scan at 0% forever."""
+        return self._progress
+
+    def add_probe(self, fn) -> None:
+        """Register a telemetry probe: a cheap callable returning a
+        ``{series_name: float}`` dict. Names ending ``_total`` are
+        cumulative counters (the sampler derives rates); everything else
+        is an instantaneous gauge. Registration is O(1) and unconditional;
+        the probe is only ever *called* by an attached sampler thread."""
+        with self._lock:
+            self._probes.append(fn)
+
+    def remove_probe(self, fn) -> None:
+        with self._lock:
+            if fn in self._probes:
+                self._probes.remove(fn)
+
+    def probe_values(self) -> dict[str, float]:
+        """Merged snapshot of every registered probe. A probe that raises
+        (e.g. mid-teardown of a degrading pipeline) is skipped — telemetry
+        must never take the scan down with it."""
+        with self._lock:
+            probes = list(self._probes)
+        out: dict[str, float] = {}
+        for fn in probes:
+            try:
+                out.update(fn())
+            except Exception:
+                pass
+        return out
 
     def ingest_remote(self, doc: dict) -> None:
         """Join a remote scan's serialized context
@@ -376,6 +432,9 @@ class TraceContext:
             self.health.clear()
             self.remote.clear()
             self._profile = None
+            self._progress = None
+            self._probes.clear()
+            self.timeseries = None
 
     # -- aggregation --------------------------------------------------------
 
@@ -661,10 +720,12 @@ def parse_traceparent(value: str | None) -> tuple[str, int | None] | None:
 
 class heartbeat:
     """Progress logging for long-running operations: while the block runs,
-    log one line every ``interval`` seconds (elapsed time plus an optional
-    ``progress()`` string) so server operators can tell a long scan from a
-    hung one. Zero threads when the block finishes before the first beat
-    fires is not attempted — the thread parks on an Event and exits quietly.
+    log one line every ``interval`` seconds (elapsed time, the scan's live
+    telemetry summary — progress %, instantaneous MB/s, ETA — plus an
+    optional ``progress()`` string) so server operators can tell a long
+    scan from a hung one, and roughly *where* it is. Zero threads when the
+    block finishes before the first beat fires is not attempted — the
+    thread parks on an Event and exits quietly.
     """
 
     def __init__(self, logger, what: str, interval: float = 30.0, progress=None):
@@ -674,7 +735,32 @@ class heartbeat:
         self.progress = progress
         self._stop = threading.Event()
         self._t0 = 0.0
+        self._last_bytes: tuple[float, int] | None = None
         self._ctx: TraceContext | None = None
+
+    def _telemetry(self) -> str:
+        """The scan's live progress (bytes walked vs scanned, MB/s between
+        beats, ETA) as one compact fragment, or '' when nothing has
+        registered progress yet. The MB/s here is *instantaneous* — the
+        delta since the previous beat — so a stalled pipeline shows 0.0
+        even when the cumulative average still looks healthy."""
+        ctx = self._ctx
+        prog = ctx.progress_peek() if ctx is not None else None
+        if prog is None:
+            return ""
+        snap = prog.snapshot()
+        now = time.perf_counter()
+        mbs = snap["mbs"]
+        if self._last_bytes is not None:
+            t0, b0 = self._last_bytes
+            dt = now - t0
+            if dt > 0:
+                mbs = (snap["bytes_scanned"] - b0) / dt / (1 << 20)
+        self._last_bytes = (now, snap["bytes_scanned"])
+        parts = [f"{snap['ratio'] * 100:.1f}%", f"{mbs:.1f} MB/s"]
+        if snap.get("eta_s") is not None:
+            parts.append(f"ETA {snap['eta_s']:.0f}s")
+        return " [" + ", ".join(parts) + "]"
 
     def _loop(self) -> None:
         # the beat thread re-enters the spawning scan's context so the log
@@ -688,6 +774,10 @@ class heartbeat:
                         extra = f" ({self.progress()})"
                     except Exception:
                         pass
+                try:
+                    extra = self._telemetry() + extra
+                except Exception:
+                    pass
                 self.logger.info(
                     "%s in progress: %.0fs elapsed%s [trace %s]",
                     self.what,

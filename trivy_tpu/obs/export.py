@@ -18,6 +18,11 @@
   stage totals it must stay consistent with.
 - :func:`context_doc` — the wire form of a context (bounded events +
   aggregates + profile) a scan server returns in its response.
+- :func:`write_timeseries_json` — the live-telemetry series (link MB/s,
+  arena occupancy, queue depths, per-device busy, progress) recorded by
+  an attached :class:`trivy_tpu.obs.timeseries.Sampler`; the same series
+  render into ``--trace-out`` timelines as Perfetto **counter tracks**
+  (``"ph": "C"`` events), local and remote alike.
 
 Every path-based writer gzips transparently when the destination ends in
 ``.gz`` — merged cross-process traces get large.
@@ -96,6 +101,8 @@ def context_doc(ctx: TraceContext, max_events: int = WIRE_MAX_EVENTS) -> dict:
         counters = dict(ctx.counters)
         samples = {k: [v[0], v[1], v[2]] for k, v in ctx.samples.items()}
         prof = ctx._profile
+        prog = ctx._progress
+        ts = ctx.timeseries
     doc = {
         "trace_id": ctx.trace_id,
         "name": ctx.name,
@@ -110,6 +117,12 @@ def context_doc(ctx: TraceContext, max_events: int = WIRE_MAX_EVENTS) -> dict:
     }
     if prof is not None:
         doc["profile"] = prof.to_dict()
+    if prog is not None:
+        doc["progress"] = prog.snapshot()
+    if ts is not None:
+        # live-telemetry series ride the wire too (bounded), so a merged
+        # client export carries the server's counter tracks
+        doc["timeseries"] = ts.to_doc()
     return doc
 
 
@@ -171,14 +184,34 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
             }
         )
 
+    def emit_counters(pid: int, series: dict, base_us: float = 0.0) -> None:
+        """Perfetto counter tracks (``"ph": "C"``): one track per telemetry
+        series, point timestamps aligned with the span clock."""
+        for name, doc in sorted(series.items()):
+            for t, v in doc.get("points", ()):
+                events.append(
+                    {
+                        "name": name,
+                        "cat": "telemetry",
+                        "ph": "C",
+                        "pid": pid,
+                        "tid": 0,
+                        "ts": max(0.0, round(base_us + t * 1e6, 3)),
+                        "args": {"value": v},
+                    }
+                )
+
     with ctx._lock:
         spans = list(ctx.events)
         remote_docs = list(ctx.remote)
+        ts = ctx.timeseries
     for sp in sorted(spans, key=lambda s: s.start):
         emit(
             1, ctx.trace_id, sp.name, sp.thread, sp.span_id, sp.parent_id,
             (sp.start - ctx.created) * 1e6, sp.duration,
         )
+    if ts is not None:
+        emit_counters(1, ts.to_doc())
     for i, doc in enumerate(remote_docs):
         pid = 2 + i
         events.append(
@@ -213,6 +246,8 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
                 sp.get("thread", 0), sp.get("span_id"), sp.get("parent_id"),
                 base_us + sp["start"] * 1e6, sp.get("duration", 0.0),
             )
+        if doc.get("timeseries"):
+            emit_counters(pid, doc["timeseries"], base_us)
     return events
 
 
@@ -262,6 +297,12 @@ def metrics_dict(ctx: TraceContext) -> dict:
         "profile": ctx.merged_profile_dict(),
         "dropped_events": ctx.dropped_events,
     }
+    if ctx.timeseries is not None:
+        # aggregate view of the live-telemetry series (count/mean/max/
+        # p50/p95 per series); full points ride --timeseries-out
+        doc["timeseries"] = ctx.timeseries.summary()
+    if ctx._progress is not None:
+        doc["progress"] = ctx._progress.snapshot()
     if remote_docs:
         doc["remote"] = [
             {
@@ -303,3 +344,38 @@ def profile_dict(ctx: TraceContext) -> dict:
 
 def write_profile_json(ctx: TraceContext, dest) -> None:
     _dump(profile_dict(ctx), dest, indent=2)
+
+
+def timeseries_dict(ctx: TraceContext) -> dict:
+    """The full live-telemetry view: every sampled series' points (local
+    plus any joined remote contexts'), the per-series summary, and the
+    final progress snapshot — what ``--timeseries-out`` writes."""
+    with ctx._lock:
+        remote_docs = list(ctx.remote)
+        ts = ctx.timeseries
+        prog = ctx._progress
+    doc = {
+        "trace_id": ctx.trace_id,
+        "name": ctx.name,
+        "series": ts.to_doc(max_points=ts._capacity) if ts is not None else {},
+        "summary": ts.summary() if ts is not None else {},
+    }
+    if prog is not None:
+        doc["progress"] = prog.snapshot()
+    remote = [
+        {
+            "trace_id": d.get("trace_id"),
+            "name": d.get("name"),
+            "series": d["timeseries"],
+            **({"progress": d["progress"]} if d.get("progress") else {}),
+        }
+        for d in remote_docs
+        if d.get("timeseries")
+    ]
+    if remote:
+        doc["remote"] = remote
+    return doc
+
+
+def write_timeseries_json(ctx: TraceContext, dest) -> None:
+    _dump(timeseries_dict(ctx), dest, indent=2)

@@ -103,6 +103,12 @@ class Gauge(_ValueMetric):
     def dec(self, amount: float = 1, **labels) -> None:
         self.inc(-amount, **labels)
 
+    def remove(self, **labels) -> None:
+        """Retire one label set (e.g. a finished scan's ``trace`` label)
+        so per-scan labels can't grow gauge cardinality without bound."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
 
 class Histogram(_Metric):
     kind = "histogram"
@@ -164,6 +170,20 @@ class Registry:
                 m = self._metrics[name] = cls(name, help, labelnames, **kw)
             elif not isinstance(m, cls):
                 raise ValueError(f"metric {name} already registered as {m.kind}")
+            elif tuple(labelnames) != m.labelnames:
+                # a silent get-or-create here would hand back an instrument
+                # whose inc()/set() then fails far from the offending
+                # registration — duplicate registration under a different
+                # shape must be loud at the registration site
+                raise ValueError(
+                    f"metric {name} already registered with labels "
+                    f"{list(m.labelnames)}, not {list(labelnames)}"
+                )
+            elif "buckets" in kw and tuple(sorted(kw["buckets"])) != m.buckets:
+                raise ValueError(
+                    f"histogram {name} already registered with different "
+                    f"buckets"
+                )
             return m
 
     def counter(self, name, help="", labelnames=()) -> Counter:

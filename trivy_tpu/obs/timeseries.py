@@ -1,0 +1,573 @@
+"""Continuous scan telemetry: a per-scan time-series sampler.
+
+Every other observability surface here is post-hoc — the stall verdict,
+the per-rule profile, and the Perfetto timeline all materialize after the
+scan finishes. This module is the live half: a background sampler thread
+per scan snapshots in-flight pipeline state on a fixed interval (default
+250 ms, knob ``--telemetry-interval``, 0 = off) into bounded ring buffers,
+the Perfetto/Prometheus *counter track* model from the tracing literature.
+
+What gets sampled (each producer registers a cheap probe on the scan's
+:class:`~trivy_tpu.obs.TraceContext`; the sampler merges them per tick):
+
+- arena occupancy (``secret.arena_free_slabs`` — the snapshot the feed
+  path always computed but never exported live)
+- per-transfer-stream in-flight window depth, feeder/confirm queue depths
+- per-device busy fraction (``device.dN.busy_ratio``), derived from the
+  dispatch/fetch busy-interval accounting in :mod:`trivy_tpu.parallel.mesh`
+- instantaneous link bandwidth (``secret.link_mbs`` =
+  Δ``bytes_uploaded``/Δt)
+- scan progress (:class:`ScanProgress`: bytes/files walked vs scanned)
+
+The series land in four places: Perfetto **counter tracks** appended to
+``--trace-out`` timelines, per-scan JSON via ``--timeseries-out``, live
+Prometheus gauges on ``GET /metrics`` (``trivy_tpu_link_mbs``,
+``trivy_tpu_device_busy_ratio{device=}``, ``trivy_tpu_arena_free_slabs``,
+``trivy_tpu_scan_progress_ratio{trace=}``), and the scan server's
+``GET /scan/<trace_id>/progress`` API plus the ``--live`` CLI line.
+
+Zero-cost-when-off: no sampler thread spawns unless telemetry is enabled
+(``start_sampler`` returns None for interval 0), probes are registered but
+never called, and :class:`ScanProgress` costs one lock+add per *file* —
+the always-on budget the health channel already set. ``bench.py --smoke``
+enforces both properties (no sampler thread on untraced reps, measured
+overhead bound).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+
+from trivy_tpu import log
+
+logger = log.logger("obs:timeseries")
+
+# default sampling cadence; --telemetry-interval / TRIVY_TPU_TELEMETRY_INTERVAL
+DEFAULT_INTERVAL = 0.25
+# per-series point bound: at the default cadence this holds ~17 min of
+# samples; older points drop (counted, never silent) so a day-long scan
+# cannot hold an unbounded series
+RING_CAPACITY = 4096
+# bounded per-series points shipped in a context_doc (scan responses ride
+# HTTP; the receiver gets a uniform stride, not a biased prefix)
+WIRE_POINTS = 512
+
+# cumulative-counter series (names ending _total) derive a rate series per
+# tick; these two shapes get friendly names instead of the generic
+# "<base>_per_s" (link bandwidth in MB/s, busy-seconds-per-second = ratio)
+_LINK_COUNTER = "secret.bytes_uploaded_total"
+_LINK_SERIES = "secret.link_mbs"
+_BUSY_RE = re.compile(r"^device\.(d\w+)\.busy_seconds_total$")
+
+
+def default_interval() -> float:
+    """Sampler cadence from ``TRIVY_TPU_TELEMETRY_INTERVAL`` (seconds),
+    falling back to :data:`DEFAULT_INTERVAL`; 0 disables."""
+    raw = os.environ.get("TRIVY_TPU_TELEMETRY_INTERVAL", "")
+    if raw:
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            pass
+    return DEFAULT_INTERVAL
+
+
+class RingBuffer:
+    """Bounded (t, value) series: append drops the oldest point past
+    ``capacity`` and counts the drop — truncation is never silent."""
+
+    __slots__ = ("points", "dropped", "capacity")
+
+    def __init__(self, capacity: int = RING_CAPACITY):
+        self.capacity = max(1, capacity)
+        self.points: deque[tuple[float, float]] = deque(maxlen=self.capacity)
+        self.dropped = 0
+
+    def append(self, t: float, value: float) -> None:
+        if len(self.points) == self.capacity:
+            self.dropped += 1
+        self.points.append((t, value))
+
+    def __len__(self) -> int:
+        return len(self.points)
+
+
+class Timeseries:
+    """Named, bounded time series for one scan (thread-safe). Timestamps
+    are seconds relative to the owning context's creation, so they align
+    with span timestamps in the Chrome-trace export."""
+
+    def __init__(self, capacity: int = RING_CAPACITY):
+        self._lock = threading.Lock()
+        self._series: dict[str, RingBuffer] = {}
+        self._capacity = capacity
+
+    def record(self, name: str, t: float, value: float) -> None:
+        with self._lock:
+            rb = self._series.get(name)
+            if rb is None:
+                rb = self._series[name] = RingBuffer(self._capacity)
+            rb.append(t, float(value))
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def points(self, name: str) -> list[tuple[float, float]]:
+        with self._lock:
+            rb = self._series.get(name)
+            return list(rb.points) if rb is not None else []
+
+    def values(self, name: str) -> list[float]:
+        return [v for _, v in self.points(name)]
+
+    def latest(self, name: str) -> float | None:
+        with self._lock:
+            rb = self._series.get(name)
+            if rb is None or not rb.points:
+                return None
+            return rb.points[-1][1]
+
+    def to_doc(self, max_points: int = WIRE_POINTS) -> dict:
+        """Wire/JSON form: ``{name: {"points": [[t, v], ...], "dropped"}}``
+        with a uniform stride past ``max_points`` (a plain prefix would
+        bias consumers toward the scan's warm-up)."""
+        with self._lock:
+            items = [
+                (name, list(rb.points), rb.dropped)
+                for name, rb in sorted(self._series.items())
+            ]
+        out = {}
+        for name, pts, dropped in items:
+            n = len(pts)
+            if n > max_points:
+                step = n / max_points
+                pts = [pts[int(i * step)] for i in range(max_points)]
+                dropped += n - max_points
+            out[name] = {
+                "points": [[round(t, 4), round(v, 6)] for t, v in pts],
+                "dropped": dropped,
+            }
+        return out
+
+    def summary(self) -> dict:
+        """Per-series {count, mean, max, p50, p95} — the aggregate view
+        bench embeds (full points ride --timeseries-out)."""
+        from trivy_tpu.obs import percentile
+
+        out = {}
+        with self._lock:
+            items = [(n, [v for _, v in rb.points])
+                     for n, rb in sorted(self._series.items())]
+        for name, vals in items:
+            if not vals:
+                continue
+            out[name] = {
+                "count": len(vals),
+                "mean": round(sum(vals) / len(vals), 6),
+                "max": round(max(vals), 6),
+                "p50": round(percentile(vals, 50), 6),
+                "p95": round(percentile(vals, 95), 6),
+            }
+        return out
+
+
+class ScanProgress:
+    """Always-on progress counters for one scan: bytes/files *walked*
+    (discovered by the artifact walk) vs *scanned* (fully processed by the
+    analyzer loop / device pipeline). Cheap enough to run untraced — one
+    lock + integer adds per file, the same budget as the health channel.
+
+    ``ratio`` is clamped monotonically non-decreasing: the walk can burst
+    ahead of scanning (discovering new bytes shrinks the raw quotient),
+    but a progress API must never tell a poller the scan went backwards.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.files_walked = 0
+        self.bytes_walked = 0
+        self.files_scanned = 0
+        self.bytes_scanned = 0
+        self.walk_complete = False
+        self.done = False
+        self.started = time.perf_counter()
+        self._max_ratio = 0.0
+        self.remote: dict | None = None  # latest joined server-side snapshot
+
+    def note_walked(self, nbytes: int, files: int = 1) -> None:
+        with self._lock:
+            self.files_walked += files
+            self.bytes_walked += nbytes
+
+    def note_scanned(self, nbytes: int, files: int = 1) -> None:
+        with self._lock:
+            self.files_scanned += files
+            self.bytes_scanned += nbytes
+
+    def finish_walk(self) -> None:
+        with self._lock:
+            self.walk_complete = True
+
+    def finish(self) -> None:
+        with self._lock:
+            self.done = True
+
+    def merge_remote(self, snapshot: dict) -> None:
+        """Latest server-side progress of a joined remote scan (client
+        mode): kept verbatim so `--live`/heartbeat can show both sides."""
+        if isinstance(snapshot, dict):
+            with self._lock:
+                self.remote = snapshot
+
+    def ratio(self) -> float:
+        with self._lock:
+            return self._ratio_locked()
+
+    def _ratio_locked(self) -> float:
+        if self.done:
+            self._max_ratio = 1.0
+            return 1.0
+        if self.bytes_walked > 0:
+            r = self.bytes_scanned / self.bytes_walked
+        elif self.files_walked > 0:
+            r = self.files_scanned / self.files_walked
+        else:
+            r = 0.0
+        # never 1.0 before finish(): the denominator may still grow before
+        # walk_complete, and even with every walked byte scanned there are
+        # trailing phases (batched-analyzer finalize, detection, report)
+        # the walked/scanned pair doesn't see — 99.9% is the honest cap
+        # for a scan that hasn't actually completed
+        r = min(r, 0.999)
+        if r > self._max_ratio:
+            self._max_ratio = r
+        return self._max_ratio
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            elapsed = time.perf_counter() - self.started
+            ratio = self._ratio_locked()
+            mbs = self.bytes_scanned / elapsed / (1 << 20) if elapsed > 0 else 0.0
+            eta = None
+            if (
+                not self.done
+                and self.walk_complete
+                and self.bytes_scanned > 0
+                and self.bytes_walked > self.bytes_scanned
+            ):
+                rate = self.bytes_scanned / elapsed
+                if rate > 0:
+                    eta = (self.bytes_walked - self.bytes_scanned) / rate
+            doc = {
+                "files_walked": self.files_walked,
+                "bytes_walked": self.bytes_walked,
+                "files_scanned": self.files_scanned,
+                "bytes_scanned": self.bytes_scanned,
+                "walk_complete": self.walk_complete,
+                "done": self.done,
+                "ratio": round(ratio, 6),
+                "elapsed_s": round(elapsed, 3),
+                "mbs": round(mbs, 3),
+                "eta_s": round(eta, 1) if eta is not None else None,
+            }
+            if self.remote is not None:
+                doc["remote"] = self.remote
+            return doc
+
+
+def _registry():
+    from trivy_tpu.obs import metrics as obs_metrics
+
+    return obs_metrics.REGISTRY
+
+
+# live-sampler accounting for the process-level gauges: the unlabeled
+# gauges (link MB/s, arena free slabs) and the per-device busy ratios are
+# "most recent sampled value in this process" — concurrent scans overwrite
+# each other (last writer wins; per-scan series stay exact in each scan's
+# ring buffers). When the LAST live sampler stops, the gauges retire so a
+# scrape after the fleet goes idle reads 0, not the final scan's last
+# value frozen forever (the admission controller reads these).
+_live_lock = threading.Lock()
+_live_samplers = 0
+_busy_devices: set[str] = set()
+
+
+def _note_sampler_started() -> None:
+    global _live_samplers
+    with _live_lock:
+        _live_samplers += 1
+
+
+def _note_sampler_stopped() -> None:
+    global _live_samplers
+    with _live_lock:
+        _live_samplers = max(0, _live_samplers - 1)
+        if _live_samplers:
+            return
+        devices = sorted(_busy_devices)
+        _busy_devices.clear()
+    reg = _registry()
+    reg.gauge(
+        "trivy_tpu_link_mbs",
+        "Instantaneous host->device link bandwidth (MB/s)",
+    ).remove()
+    reg.gauge(
+        "trivy_tpu_arena_free_slabs",
+        "Free slabs in the secret feed's chunk arena",
+    ).remove()
+    busy = reg.gauge(
+        "trivy_tpu_device_busy_ratio",
+        "Fraction of the last sampling interval the device had "
+        "work in flight",
+        labelnames=("device",),
+    )
+    for d in devices:
+        busy.remove(device=d)
+
+
+class Sampler:
+    """One scan's background sampler thread.
+
+    Lifecycle mirrors ``obs.heartbeat``: the thread re-enters the spawning
+    scan's :class:`TraceContext` (so probe-side ``obs.current()`` calls and
+    json log lines correlate), parks on an Event between ticks, and exits
+    on :meth:`stop` — which the owning scope calls from a ``finally``, so
+    scan death, feed poison, and the degraded host-fallback path all stop
+    the thread the same way completion does. A final tick runs at stop so
+    the series always carry the end state.
+    """
+
+    def __init__(self, ctx, interval: float = DEFAULT_INTERVAL,
+                 clock=time.perf_counter):
+        self.ctx = ctx
+        self.interval = interval
+        self.clock = clock
+        self.ts = Timeseries()
+        ctx.timeseries = self.ts
+        self._stop = threading.Event()
+        self._last: dict[str, tuple[float, float]] = {}
+        self._progress_gauge_set = False
+        self._counted_live = False
+        self._thread: threading.Thread | None = None
+
+    # -- sampling -----------------------------------------------------------
+
+    def sample_once(self) -> None:
+        """One tick: merge every probe, record gauge series directly,
+        derive rate series for cumulative ``*_total`` counters, fold in
+        scan progress, and mirror the headline values to the process
+        Prometheus gauges."""
+        now = self.clock()
+        t = now - self.ctx.created
+        vals = self.ctx.probe_values()
+        reg = _registry()
+        for name, v in vals.items():
+            self.ts.record(name, t, v)
+            if not name.endswith("_total"):
+                continue
+            prev = self._last.get(name)
+            self._last[name] = (now, v)
+            if prev is None:
+                continue
+            dt = now - prev[0]
+            if dt <= 0:
+                continue
+            rate = max(0.0, (v - prev[1]) / dt)
+            m = _BUSY_RE.match(name)
+            if name == _LINK_COUNTER:
+                mbs = rate / (1 << 20)
+                self.ts.record(_LINK_SERIES, t, mbs)
+                reg.gauge(
+                    "trivy_tpu_link_mbs",
+                    "Instantaneous host->device link bandwidth (MB/s)",
+                ).set(round(mbs, 3))
+            elif m:
+                ratio = min(1.0, rate)
+                self.ts.record(f"device.{m.group(1)}.busy_ratio", t, ratio)
+                reg.gauge(
+                    "trivy_tpu_device_busy_ratio",
+                    "Fraction of the last sampling interval the device had "
+                    "work in flight",
+                    labelnames=("device",),
+                ).set(round(ratio, 4), device=m.group(1))
+                with _live_lock:
+                    _busy_devices.add(m.group(1))
+            else:
+                self.ts.record(name[: -len("_total")] + "_per_s", t, rate)
+        if "secret.arena_free_slabs" in vals:
+            reg.gauge(
+                "trivy_tpu_arena_free_slabs",
+                "Free slabs in the secret feed's chunk arena",
+            ).set(vals["secret.arena_free_slabs"])
+        prog = self.ctx.progress_peek()
+        if prog is not None:
+            snap = prog.snapshot()
+            self.ts.record("progress.ratio", t, snap["ratio"])
+            self.ts.record("progress.files_walked", t, snap["files_walked"])
+            self.ts.record("progress.files_scanned", t, snap["files_scanned"])
+            self.ts.record("progress.bytes_scanned_total", t,
+                           snap["bytes_scanned"])
+            reg.gauge(
+                "trivy_tpu_scan_progress_ratio",
+                "Live scan progress (bytes scanned / bytes walked)",
+                labelnames=("trace",),
+            ).set(snap["ratio"], trace=self.ctx.trace_id)
+            self._progress_gauge_set = True
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Sampler":
+        # baseline tick before the thread parks on its first interval, so
+        # even a sub-interval scan gets a (start, stop) pair and its rate
+        # series (link MB/s, busy ratio) have a delta to derive from
+        # count this sampler live BEFORE its first gauge write: a
+        # concurrently-stopping last sampler must not retire the gauges
+        # this scan's baseline tick just set
+        _note_sampler_started()
+        self._counted_live = True
+        try:
+            self.sample_once()
+        except Exception as e:
+            logger.debug("baseline telemetry tick failed: %s", e)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"telemetry-sampler-{self.ctx.trace_id[:8]}",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from trivy_tpu import obs
+
+        with obs.activate(self.ctx):
+            while not self._stop.wait(self.interval):
+                try:
+                    self.sample_once()
+                except Exception as e:  # a dying probe must not kill ticks
+                    logger.debug("telemetry tick failed: %s", e)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the thread (idempotent), take one final sample so the
+        series end at the scan's end state, and retire this scan's
+        progress gauge label so /metrics cardinality stays bounded. When
+        this was the last live sampler in the process, the shared gauges
+        (link, busy, arena) retire too — an idle fleet scrapes as 0, not
+        as the final scan's last values frozen forever."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        try:
+            self.sample_once()
+        except Exception as e:
+            logger.debug("final telemetry tick failed: %s", e)
+        if self._progress_gauge_set:
+            _registry().gauge(
+                "trivy_tpu_scan_progress_ratio",
+                "Live scan progress (bytes scanned / bytes walked)",
+                labelnames=("trace",),
+            ).remove(trace=self.ctx.trace_id)
+            self._progress_gauge_set = False
+        if self._counted_live:
+            self._counted_live = False
+            _note_sampler_stopped()
+
+
+def start_sampler(ctx, interval: float | None = None) -> Sampler | None:
+    """Spawn a sampler for ``ctx`` unless telemetry is off. ``interval``
+    None resolves the env knob; 0 (the ``--telemetry-interval 0`` spelling)
+    disables everything — no thread, no ring buffers, no gauges."""
+    if interval is None:
+        interval = default_interval()
+    if interval <= 0:
+        return None
+    return Sampler(ctx, interval=interval).start()
+
+
+class LiveProgress:
+    """The ``--live`` CLI surface: one carriage-returned status line on a
+    short cadence — progress %, MB/s, ETA, device busy %, arena occupancy
+    — fed from :class:`ScanProgress` plus the sampler's latest points.
+    Prints to ``stream`` (stderr by default) and finishes with a newline
+    so the report output below it stays clean."""
+
+    def __init__(self, ctx, stream=None, interval: float = 0.5):
+        import sys
+
+        self.ctx = ctx
+        self.stream = stream or sys.stderr
+        self.interval = interval
+        self._stop = threading.Event()
+        self._wrote = False
+        self._thread: threading.Thread | None = None
+
+    def line(self) -> str:
+        prog = self.ctx.progress_peek()
+        snap = prog.snapshot() if prog is not None else {}
+        parts = []
+        if snap:
+            parts.append(f"{snap['ratio'] * 100:5.1f}%")
+            parts.append(f"{snap['mbs']:.1f} MB/s")
+            if snap.get("eta_s") is not None:
+                parts.append(f"ETA {snap['eta_s']:.0f}s")
+            remote = snap.get("remote")
+            if remote and remote.get("Ratio") is not None:
+                parts.append(f"server {float(remote['Ratio']) * 100:.0f}%")
+        ts = getattr(self.ctx, "timeseries", None)
+        if ts is not None:
+            link = ts.latest(_LINK_SERIES)
+            if link is not None:
+                parts.append(f"link {link:.1f} MB/s")
+            busy = [
+                ts.latest(n)
+                for n in ts.names()
+                if n.startswith("device.") and n.endswith(".busy_ratio")
+            ]
+            busy = [b for b in busy if b is not None]
+            if busy:
+                parts.append(f"busy {100 * sum(busy) / len(busy):.0f}%")
+            free = ts.latest("secret.arena_free_slabs")
+            if free is not None:
+                parts.append(f"arena free {free:.0f}")
+        return "scan " + " | ".join(parts) if parts else "scan starting..."
+
+    def start(self) -> "LiveProgress":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="telemetry-live",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from trivy_tpu import obs
+
+        with obs.activate(self.ctx):
+            while not self._stop.wait(self.interval):
+                self._emit()
+
+    def _emit(self) -> None:
+        try:
+            self.stream.write("\r\x1b[2K" + self.line())
+            self.stream.flush()
+            self._wrote = True
+        except (ValueError, OSError):  # closed stream on teardown
+            self._stop.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._emit()
+        if self._wrote:
+            try:
+                self.stream.write("\n")
+                self.stream.flush()
+            except (ValueError, OSError):
+                pass

@@ -162,6 +162,66 @@ class CircuitBreaker:
         with self._lock:
             return [i for i in range(self.n) if self._open[i]]
 
+class DeviceBusyTracker:
+    """Per-device busy-interval accounting for live utilization telemetry.
+
+    A device is *busy* while at least one dispatched batch has not yet
+    fetched: :meth:`begin` at placement, :meth:`end` when the caller
+    reports the fetch outcome. Overlapping in-flight windows on one device
+    merge into a single busy interval (dispatch is async and pipelined),
+    so ``busy_seconds`` is wall time with work in flight — exactly the
+    numerator of the sampler's per-interval busy fraction
+    (Δbusy_seconds/Δt, the ``trivy_tpu_device_busy_ratio`` gauge).
+
+    Leak shape: a batch dropped without an ``end`` (scan generator closed
+    mid-flight) would pin the device busy; :meth:`end` tolerates the
+    matching underflow and the sampler stops with the scan, so the error
+    is bounded to that scan's final samples.
+    """
+
+    def __init__(self, n: int, clock=time.monotonic):
+        self.n = max(1, n)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._inflight = [0] * self.n
+        self._busy = [0.0] * self.n
+        self._since = [0.0] * self.n
+
+    def begin(self, i: int | None) -> None:
+        i = (i or 0) % self.n
+        with self._lock:
+            if self._inflight[i] == 0:
+                self._since[i] = self.clock()
+            self._inflight[i] += 1
+
+    def end(self, i: int | None) -> None:
+        i = (i or 0) % self.n
+        with self._lock:
+            if self._inflight[i] <= 0:
+                return  # unmatched end (retry bookkeeping); never negative
+            self._inflight[i] -= 1
+            if self._inflight[i] == 0:
+                self._busy[i] += self.clock() - self._since[i]
+
+    def busy_seconds(self) -> list[float]:
+        """Cumulative busy wall-time per device, including the currently
+        open interval — monotonic, so samplers can safely differentiate."""
+        now = self.clock()
+        with self._lock:
+            return [
+                b + (now - s if f > 0 else 0.0)
+                for b, s, f in zip(self._busy, self._since, self._inflight)
+            ]
+
+    def probe(self) -> dict[str, float]:
+        """Telemetry-probe fragment: ``device.dN.busy_seconds_total``
+        series (cumulative counters the sampler turns into busy ratios)."""
+        return {
+            f"device.d{i}.busy_seconds_total": s
+            for i, s in enumerate(self.busy_seconds())
+        }
+
+
 try:  # jax >= 0.5 top-level spelling
     _shard_map = jax.shard_map
 except AttributeError:
@@ -361,6 +421,10 @@ class StagedDispatch:
             self.pad_to = self.rows_multiple
             self.n_streams = 1
             self.breaker = None
+        # live utilization telemetry: busy-interval accounting per dispatch
+        # target (one slot on the mesh/default flavors, one per round-robin
+        # device); the feed path's probe exposes it as busy_seconds counters
+        self.busy = DeviceBusyTracker(self.n_streams)
 
     def add_stage(self, name: str, fn, out_axes: int = 2) -> None:
         """Register a row-wise kernel ``[B, C] -> [B, ...]``. ``out_axes``
@@ -389,9 +453,9 @@ class StagedDispatch:
             chunks = pad_batch(chunks, self.pad_to)
         if self.mesh is not None:
             faults.check("device.dispatch", key="d0")
-            return (
-                jax.device_put(chunks, batch_sharding(self.mesh)), None,
-            )
+            dev = jax.device_put(chunks, batch_sharding(self.mesh))
+            self.busy.begin(None)
+            return dev, None
         if self.devices:
             with self._lock:
                 i = self.breaker.next_device(self._next)
@@ -409,15 +473,21 @@ class StagedDispatch:
                 self.breaker.record_failure(i)
                 raise
             obs.current().count(f"mesh.d{i}.batches")
+            self.busy.begin(i)
             return dev, i
         faults.check("device.dispatch", key="d0")
-        return jax.device_put(chunks), None
+        dev = jax.device_put(chunks)
+        self.busy.begin(None)
+        return dev, None
 
     def run(self, name: str, dev, device_idx=None):
         """Launch stage ``name`` on an already-resident batch (async)."""
         return self._stages[name](dev)
 
     def record_result(self, i, ok: bool) -> None:
+        # the fetch outcome closes the batch's busy interval on every
+        # flavor (i is None on mesh/default placement: slot 0)
+        self.busy.end(i)
         if self.breaker is None or i is None:
             return
         if ok:

@@ -17,5 +17,15 @@ HEALTHZ = "/healthz"
 VERSION = "/version"
 METRICS = "/metrics"
 
+# live scan-progress API: GET /scan/<trace_id>/progress returns the
+# monotonically non-decreasing progress snapshot of an in-flight (or
+# recently finished) scan joined to that trace id
+SCAN_PROGRESS_PREFIX = "/scan/"
+SCAN_PROGRESS_SUFFIX = "/progress"
+
+
+def scan_progress_path(trace_id: str) -> str:
+    return f"{SCAN_PROGRESS_PREFIX}{trace_id}{SCAN_PROGRESS_SUFFIX}"
+
 # ref: pkg/flag/server_flags.go default token header
 DEFAULT_TOKEN_HEADER = "Trivy-Token"

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import random
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -25,6 +26,11 @@ from trivy_tpu.scanner import ScanOptions
 from trivy_tpu.types import OS, Result
 
 logger = log.logger("rpc:client")
+
+# cadence of the client-side progress join: while a remote Scanner.Scan is
+# in flight and telemetry is on, the driver polls the server's progress API
+# this often and folds the snapshot into the local scan's ScanProgress
+PROGRESS_POLL_SECS = 1.0
 
 MAX_RETRIES = 10  # ref: retry.go retry count
 MAX_BACKOFF = 5.0  # per-sleep cap (jittered: actual sleep ~U(0, backoff))
@@ -119,6 +125,27 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
     raise RPCError(f"{path}: retries exhausted: {last}")
 
 
+def get_progress(server: str, trace_id: str, token: str = "",
+                 token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                 timeout: float = 5.0) -> dict:
+    """One poll of the server's live progress API
+    (``GET /scan/<trace_id>/progress``). Raises :class:`RPCError` on an
+    unknown trace id or connectivity failure — deliberately no retry loop:
+    progress polling is advisory and the next tick polls again anyway."""
+    base = server if "://" in server else f"http://{server}"
+    url = base.rstrip("/") + rpc.scan_progress_path(trace_id)
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header(token_header, token)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        raise RPCError(f"progress {trace_id}: HTTP {e.code}") from e
+    except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+        raise RPCError(f"progress {trace_id}: {e}") from e
+
+
 class RemoteCache:
     """Cache facade backed by the server's Cache service
     (ref: pkg/cache/remote.go) — what client-side analysis writes to."""
@@ -177,33 +204,73 @@ class RemoteDriver:
         self.retries = retries
         self.deadline = deadline
 
+    def progress(self, trace_id: str | None = None) -> dict:
+        """Live progress of the remote scan joined to ``trace_id`` (the
+        active trace by default) — the client half of the progress API."""
+        return get_progress(
+            self.base, trace_id or obs.current().trace_id,
+            token=self.token, token_header=self.token_header,
+        )
+
+    def _poll_progress(self, ctx, stop: threading.Event) -> None:
+        """Background join of the server's live progress while the scan
+        RPC is in flight: each snapshot folds into the local ScanProgress
+        (its ``remote`` field), so ``--live`` and heartbeats can show the
+        server side of a remote scan as it runs."""
+        with obs.activate(ctx):
+            while not stop.wait(PROGRESS_POLL_SECS):
+                try:
+                    snap = self.progress(ctx.trace_id)
+                except Exception:
+                    # advisory polling: ANY failure (scan not registered
+                    # yet, a proxy's HTML error body breaking json.loads,
+                    # a truncated read) skips this tick, never kills the
+                    # poller for the rest of a long scan
+                    continue
+                ctx.progress().merge_remote(snap)
+
     def scan(self, target: str, artifact_id: str, blob_ids: list[str],
              options: ScanOptions):
         ctx = obs.current()
         # the rpc.scan span is the parent the server's trace joins under
         # (its id rides the traceparent header _post attaches); WantTrace
         # asks the server to return its span table, which merges into this
-        # context so --trace-out/report cover both sides of the wire
-        with ctx.span("rpc.scan"):
-            resp = _post(
-                self.base,
-                rpc.SCANNER_SCAN,
-                {
-                    "Target": target,
-                    "ArtifactID": artifact_id,
-                    "BlobIDs": blob_ids,
-                    "Options": {
-                        "Scanners": list(options.scanners),
-                        "ListAllPkgs": options.list_all_pkgs,
-                    },
-                    "WantTrace": bool(ctx.enabled),
-                },
-                self.token,
-                self.token_header,
-                self.timeout,
-                self.retries,
-                self.deadline,
+        # context so --trace-out/report cover both sides of the wire.
+        # With telemetry attached (a sampler set ctx.timeseries), a poller
+        # joins the server's live progress for the duration of the RPC.
+        stop = threading.Event()
+        poller = None
+        if ctx.timeseries is not None:
+            poller = threading.Thread(
+                target=self._poll_progress, args=(ctx, stop), daemon=True,
+                name="rpc-progress-poll",
             )
+            poller.start()
+        try:
+            with ctx.span("rpc.scan"):
+                resp = _post(
+                    self.base,
+                    rpc.SCANNER_SCAN,
+                    {
+                        "Target": target,
+                        "ArtifactID": artifact_id,
+                        "BlobIDs": blob_ids,
+                        "Options": {
+                            "Scanners": list(options.scanners),
+                            "ListAllPkgs": options.list_all_pkgs,
+                        },
+                        "WantTrace": bool(ctx.enabled),
+                    },
+                    self.token,
+                    self.token_header,
+                    self.timeout,
+                    self.retries,
+                    self.deadline,
+                )
+        finally:
+            if poller is not None:
+                stop.set()
+                poller.join(timeout=5.0)
         if ctx.enabled and resp.get("Trace"):
             ctx.ingest_remote(resp["Trace"])
         results = [Result.from_dict(r) for r in resp.get("Results", [])]

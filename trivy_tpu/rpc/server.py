@@ -17,16 +17,41 @@ import hmac
 import json
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from trivy_tpu import log, obs, rpc
 from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import timeseries as obs_timeseries
 from trivy_tpu.scanner import ScanOptions
 
 logger = log.logger("rpc:server")
 
 # progress-log cadence for long-running server scans
 HEARTBEAT_SECS = 30.0
+
+# finished scans keep their final progress snapshot for late pollers; the
+# table is bounded so trace ids can't accumulate forever
+FINISHED_PROGRESS_KEEP = 256
+
+
+def _progress_wire(snap: dict) -> dict:
+    """ScanProgress.snapshot() -> the PascalCase wire form of the progress
+    API (one place, so the client helper and tests can't drift)."""
+    doc = {
+        "FilesWalked": snap["files_walked"],
+        "BytesWalked": snap["bytes_walked"],
+        "FilesScanned": snap["files_scanned"],
+        "BytesScanned": snap["bytes_scanned"],
+        "WalkComplete": snap["walk_complete"],
+        "Done": snap["done"],
+        "Ratio": snap["ratio"],
+        "ElapsedSeconds": snap["elapsed_s"],
+        "MBs": snap["mbs"],
+    }
+    if snap.get("eta_s") is not None:
+        doc["ETASeconds"] = snap["eta_s"]
+    return doc
 
 # request-body ceiling; blobs are analysis metadata, not file contents, so
 # 256 MiB is generous headroom while bounding a hostile Content-Length
@@ -189,6 +214,35 @@ class ScanServer:
         # "draining" (load balancers stop routing) and new RPC requests
         # get 503 + Retry-After; in-flight scans run to completion
         self.draining = False
+        # live progress registry for GET /scan/<trace_id>/progress:
+        # in-flight scans map trace id -> their ScanProgress; finished
+        # scans keep a bounded table of final snapshots for late pollers
+        self._progress_lock = threading.Lock()
+        self._progress_active: dict[str, object] = {}
+        self._progress_finished: OrderedDict[str, dict] = OrderedDict()
+
+    # -- live progress registry ---------------------------------------------
+
+    def _progress_register(self, trace_id: str, progress) -> None:
+        with self._progress_lock:
+            self._progress_active[trace_id] = progress
+
+    def _progress_retire(self, trace_id: str) -> None:
+        with self._progress_lock:
+            prog = self._progress_active.pop(trace_id, None)
+            if prog is None:
+                return
+            self._progress_finished[trace_id] = prog.snapshot()
+            self._progress_finished.move_to_end(trace_id)
+            while len(self._progress_finished) > FINISHED_PROGRESS_KEEP:
+                self._progress_finished.popitem(last=False)
+
+    def progress_snapshot(self, trace_id: str) -> dict | None:
+        with self._progress_lock:
+            prog = self._progress_active.get(trace_id)
+            if prog is not None:
+                return prog.snapshot()
+            return self._progress_finished.get(trace_id)
 
     # -- service methods (JSON dict in/out) ---------------------------------
 
@@ -211,18 +265,34 @@ class ScanServer:
             trace_id=joined[0] if joined else None,
             parent_span_id=joined[1] if joined else None,
         ) as ctx:
-            with obs.heartbeat(
-                logger, f"scan of {target or '<unnamed>'}", HEARTBEAT_SECS
-            ):
-                t0 = time.perf_counter()
-                with ctx.span("server.scan"):
-                    results, os_info = self.driver.scan(
-                        target,
-                        req.get("ArtifactID", ""),
-                        list(req.get("BlobIDs", [])),
-                        options,
-                    )
-                dt = time.perf_counter() - t0
+            # live telemetry: one sampler per server-side scan (cadence via
+            # TRIVY_TPU_TELEMETRY_INTERVAL, 0 disables) feeding the counter
+            # tracks shipped back in the Trace block and the process gauges
+            # on GET /metrics; the progress registry serves
+            # GET /scan/<trace_id>/progress while this request runs
+            progress = ctx.progress()
+            self._progress_register(ctx.trace_id, progress)
+            sampler = obs_timeseries.start_sampler(ctx)
+            try:
+                with obs.heartbeat(
+                    logger, f"scan of {target or '<unnamed>'}", HEARTBEAT_SECS
+                ):
+                    t0 = time.perf_counter()
+                    with ctx.span("server.scan"):
+                        results, os_info = self.driver.scan(
+                            target,
+                            req.get("ArtifactID", ""),
+                            list(req.get("BlobIDs", [])),
+                            options,
+                        )
+                    dt = time.perf_counter() - t0
+                progress.finish()
+            finally:
+                # scan death stops the sampler exactly like completion —
+                # the finished table then serves the last honest snapshot
+                if sampler is not None:
+                    sampler.stop()
+                self._progress_retire(ctx.trace_id)
             self.metrics.observe_scan(ctx, dt)
         resp = {
             "OS": os_info.to_dict() if os_info else None,
@@ -293,6 +363,17 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             self.end_headers()
             self.wfile.write(body)
 
+        def _token_ok(self) -> bool:
+            """Constant-time token check shared by every authenticated
+            route — one implementation, so the RPC POSTs and the progress
+            GET cannot drift apart."""
+            if not token:
+                return True
+            return hmac.compare_digest(
+                self.headers.get(token_header, "").encode("latin-1", "replace"),
+                token.encode("latin-1", "replace"),
+            )
+
         def _reply_text(self, code: int, body: bytes, content_type: str) -> None:
             self._status = code
             self.send_response(code)
@@ -331,6 +412,25 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 )
                 self._reply_text(200, body.encode(), obs_metrics.CONTENT_TYPE)
                 return
+            if self.path.startswith(rpc.SCAN_PROGRESS_PREFIX) and (
+                self.path.endswith(rpc.SCAN_PROGRESS_SUFFIX)
+            ):
+                # unlike the aggregate /healthz and /metrics probes, this
+                # route exposes per-scan activity keyed by trace id, so a
+                # token-protected server requires the token here too (the
+                # client helper already sends it)
+                if not self._token_ok():
+                    self._reply(401, {"error": "invalid token"})
+                    return
+                trace_id = self.path[
+                    len(rpc.SCAN_PROGRESS_PREFIX): -len(rpc.SCAN_PROGRESS_SUFFIX)
+                ]
+                snap = server.progress_snapshot(trace_id)
+                if snap is None:
+                    self._reply(404, {"error": f"unknown trace id {trace_id}"})
+                    return
+                self._reply(200, {"TraceID": trace_id, **_progress_wire(snap)})
+                return
             self._reply(404, {"error": "not found"})
 
         def do_POST(self):
@@ -346,10 +446,7 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                     headers={"Retry-After": "1"},
                 )
                 return
-            if token and not hmac.compare_digest(
-                self.headers.get(token_header, "").encode("latin-1", "replace"),
-                token.encode("latin-1", "replace"),
-            ):
+            if not self._token_ok():
                 self._reply(401, {"error": "invalid token"})
                 return
             m = server.metrics

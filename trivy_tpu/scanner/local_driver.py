@@ -31,6 +31,19 @@ class LocalDriver:
         self, target: str, artifact_id: str, blob_ids: list[str], options: ScanOptions
     ) -> tuple[list[Result], OS | None]:
         ctx = obs.current()
+        # server-side live progress: when nothing upstream tracked progress
+        # (a remote client's analysis walk ran in another process), the
+        # blob set is this scan's work-list — count it so
+        # GET /scan/<trace_id>/progress moves while detection runs. A local
+        # CLI scan's progress is owned by the artifact walk; don't muddy it.
+        prog = ctx.progress()
+        track_blobs = prog.files_walked == 0
+        if track_blobs:
+            # finish_walk() waits until results are assembled: the ratio
+            # caps at 0.999 regardless (only finish() reports 100%), but
+            # "work-list final" should not be claimed while detection can
+            # still be running
+            prog.note_walked(0, files=len(blob_ids))
         with ctx.span("driver.apply_layers"):
             blobs = []
             for bid in blob_ids:
@@ -38,6 +51,8 @@ class LocalDriver:
                 if d is None:
                     raise KeyError(f"blob missing from cache: {bid}")
                 blobs.append(BlobInfo.from_dict(d))
+                if track_blobs:
+                    prog.note_scanned(0)
             detail = apply_layers(blobs)
         results: list[Result] = []
 
@@ -59,6 +74,8 @@ class LocalDriver:
         from trivy_tpu.scanner.post import post_scan
 
         results = post_scan(results)
+        if track_blobs:
+            prog.finish_walk()
         return results, detail.os
 
     # -- per-class assembly (ref: scan.go:153-318) --------------------------

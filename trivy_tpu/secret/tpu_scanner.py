@@ -556,6 +556,9 @@ class TpuSecretScanner:
                 )
                 for name in stages:
                     np.asarray(self._staged.run(name, dev, didx))
+                # close the warm batch's busy interval: warm-up must not
+                # pin the utilization telemetry's in-flight accounting
+                self._staged.record_result(didx, True)
 
     def _ensure_license_stage(self) -> None:
         """Register the license gram-gate kernel as a fused stage (once per
@@ -772,6 +775,12 @@ class _ScanRun:
         # pool cannot accumulate unbounded _FileState.data on a large
         # streaming scan (file bytes are released once its confirm runs)
         self.confirm_slots = threading.Semaphore(sc.confirm_workers * 4)
+        # live-telemetry state (obs/timeseries.py): per-stream in-flight
+        # window depths and the confirm queue depth, updated per batch /
+        # per confirm — cheap enough to keep on untraced scans, read only
+        # by an attached sampler's probe
+        self._stream_inflight = [0] * streams
+        self._confirm_inflight = 0
         self.workers = [
             threading.Thread(
                 target=self._worker, args=(i,), daemon=True,
@@ -784,11 +793,35 @@ class _ScanRun:
         )
 
     def start(self) -> None:
+        self.ctx.add_probe(self._telemetry_probe)
         for w in self.workers:
             w.start()
         self.feeder.start()
 
+    def _telemetry_probe(self) -> dict[str, float]:
+        """In-flight pipeline state for the telemetry sampler: arena
+        occupancy, queue depths, per-stream windows, link-byte and
+        per-device busy counters. Called only from a sampler thread
+        (a few times per second); every read is a lock-or-GIL snapshot."""
+        sc = self.sc
+        vals = {
+            "secret.arena_free_slabs": float(self.arena.free_slabs),
+            "secret.arena_slabs": float(self.arena.n_slabs),
+            "secret.feed_queue_depth": float(self.in_q.qsize()),
+            "secret.files_pending": float(len(self.states)),
+            "secret.results_buffered": float(len(self.results)),
+            "secret.confirm_inflight": float(self._confirm_inflight),
+            "secret.bytes_uploaded_total": float(
+                sc.stats.snapshot()["bytes_uploaded"]
+            ),
+        }
+        for i, n in enumerate(self._stream_inflight):
+            vals[f"secret.stream{i}.inflight"] = float(n)
+        vals.update(sc._staged.busy.probe())
+        return vals
+
     def close(self) -> None:
+        self.ctx.remove_probe(self._telemetry_probe)
         self.stop.set()
         self.feeder.join(timeout=10.0)
         for w in self.workers:
@@ -880,8 +913,15 @@ class _ScanRun:
     def _acquire_slot(self) -> bool:
         while not (self.stop.is_set() or self.error is not None):
             if self.confirm_slots.acquire(timeout=0.2):
+                with self.lock:
+                    self._confirm_inflight += 1
                 return True
         return False
+
+    def _release_slot(self) -> None:
+        with self.lock:
+            self._confirm_inflight -= 1
+        self.confirm_slots.release()
 
     def _set_result(self, fidx: int, value) -> None:
         with self.cond:
@@ -893,7 +933,7 @@ class _ScanRun:
             with obs.activate(self.ctx), self.ctx.span("secret.confirm"):
                 return self.sc._confirm(st, self.prof)
         finally:
-            self.confirm_slots.release()
+            self._release_slot()
 
     def _host_task(self, path: str, data: bytes) -> Secret:
         # degraded-mode rung: the exact host engine IS the parity oracle,
@@ -902,7 +942,7 @@ class _ScanRun:
             with obs.activate(self.ctx), self.ctx.span("secret.host_fallback"):
                 return self.sc.exact.scan_bytes(path, data)
         finally:
-            self.confirm_slots.release()
+            self._release_slot()
 
     def _submit_confirm(self, fidx: int, st: _FileState) -> None:
         if not self._acquire_slot():
@@ -1175,9 +1215,12 @@ class _ScanRun:
             work = [(batch, meta, slab_id, retries)]
             while work:
                 b, m, sid, r = work.pop()
+                placed = False
+                didx = None
                 try:
                     with ctx.span("secret.dispatch"):
                         dev, didx = staged.put(b)
+                        placed = True
                         h: dict = {}
                         if use_pf:
                             h["pre"] = staged.run("prefilter", dev, didx)
@@ -1187,7 +1230,11 @@ class _ScanRun:
                             h["lic"] = staged.run("license", dev, didx)
                 except Exception as e:
                     # dispatch-time failure (breaker already notified by
-                    # the placement layer); walk the ladder
+                    # the placement layer); walk the ladder. A batch that
+                    # placed but failed at stage launch closes its busy
+                    # interval here (no fetch will ever report it)
+                    if placed:
+                        staged.busy.end(didx)
                     work.extend(recover(b, m, sid, r, e))
                     continue
                 pending.append((dev, m, b, sid, didx, r, h))
@@ -1260,9 +1307,13 @@ class _ScanRun:
 
         def release_pending() -> None:
             while pending:
-                _, _, _, sid, _, _, _ = pending.popleft()
+                _, _, _, sid, didx, _, _ = pending.popleft()
                 if sid is not None:
                     self.arena.release(sid)
+                # close the dropped batch's busy interval: a degraded scan
+                # runs on for minutes, and an unclosed interval would pin
+                # the dead device's busy_ratio gauge at 1.0 the whole time
+                staged.busy.end(didx)
 
         with obs.activate(ctx):
             try:
@@ -1273,10 +1324,13 @@ class _ScanRun:
                         break
                     slab_id, batch, meta = item
                     dispatch_batch(batch, meta, slab_id, 0)
+                    self._stream_inflight[wid] = len(pending)
                     while len(pending) >= sc.inflight:
                         fetch_oldest()
+                        self._stream_inflight[wid] = len(pending)
                 while pending and not self._aborted():
                     fetch_oldest()
+                    self._stream_inflight[wid] = len(pending)
             except _DeviceFailed as e:
                 release_pending()
                 if sc._host_fallback:
@@ -1288,6 +1342,7 @@ class _ScanRun:
                 self._fail(e)
             finally:
                 release_pending()
+                self._stream_inflight[wid] = 0
                 if self.degraded:
                     # return whatever the feeder parked before it noticed
                     while True:
