@@ -303,6 +303,9 @@ def bench_e2e_best(scanner, files, rng, device_mbs, reps=None):
     # utilization metrics --check-regression guards (link_mbs_p50/p95,
     # device_busy_ratio)
     tr = one_rep(enabled=True, telemetry=True)
+    # the traced rep's metrics doc carries the effective knob snapshot the
+    # scan ran with (the same block --metrics-out ships on real scans)
+    tr["ctx"].tuning = {"config": scanner.tuning_snapshot()}
     m = obs_export.metrics_dict(tr["ctx"])
     prof = m.get("profile") or {}
     med = median([r["e2e_mbs"] for r in reps_out])
@@ -940,9 +943,14 @@ def _telemetry_overhead(scanner, files) -> tuple[float, list[str]]:
             gen = scanner.scan_files(files)
             next(gen, None)  # mid-flight: the pipeline threads are live
             if not telemetry:
+                # neither the sampler nor the tuning controller may be
+                # live on an untraced, controller-off rep (both are
+                # zero-cost-when-off claims)
                 off_threads.extend(
                     t.name for t in threading.enumerate()
-                    if t.name.startswith("telemetry-sampler")
+                    if t.name.startswith(
+                        ("telemetry-sampler", "tuning-controller")
+                    )
                 )
             for _ in gen:
                 pass
@@ -964,6 +972,103 @@ def _telemetry_overhead(scanner, files) -> tuple[float, list[str]]:
             break
         overhead = min(overhead, measure())
     return overhead, sorted(set(off_threads))
+
+
+def _smoke_controller() -> str | None:
+    """Tuning-controller gates for ``--smoke``: (1) drive the decision
+    core with a scripted gauge feed (feed-starved, then device-bound) and
+    validate the decision-log SCHEMA plus the replay invariant — per-knob
+    deltas sum exactly to final - initial; (2) run one real controller-on
+    scan and require a well-formed ``tuning`` block with no leaked
+    controller thread. Returns an error string, or None when clean."""
+    import threading
+
+    from trivy_tpu import obs
+    from trivy_tpu import tuning as tuning_mod
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    class _Stub:
+        def __init__(self):
+            self.k = {"feed_streams": 2, "inflight": 2, "arena_slabs": 8}
+
+        def knobs(self):
+            return dict(self.k)
+
+        def limits(self):
+            return {"max_streams": 4, "max_inflight": 4,
+                    "max_arena_slabs": 16}
+
+        def set_streams(self, n):
+            self.k["feed_streams"] = n
+
+        def set_inflight(self, n):
+            self.k["inflight"] = n
+
+        def grow_arena(self, n):
+            self.k["arena_slabs"] = min(16, self.k["arena_slabs"] + n)
+            return self.k["arena_slabs"]
+
+    stub = _Stub()
+    initial = stub.knobs()
+    ctl = tuning_mod.TuningController(stub, interval=0.05)
+    starved = {"queue_depth": 2.0, "busy_ratio": 0.2, "link_mbs": 5.0,
+               "arena_free": 1.0, "oom_splits": 0.0}
+    bound = dict(starved, busy_ratio=1.0, queue_depth=0.0)
+    for _ in range(8):
+        ctl.step(starved)
+    for _ in range(8):
+        ctl.step(bound)
+    ctl.stop()
+    doc = ctl.doc()
+    log = doc.get("decision_log") or []
+    if not log:
+        return (
+            "tuning controller fired zero decisions on a scripted "
+            "feed-starved/device-bound gauge feed"
+        )
+    for d in log:
+        missing = [f for f in tuning_mod.DECISION_FIELDS if f not in d]
+        if missing:
+            return f"decision-log entry missing field(s) {missing}: {d}"
+        gmissing = [
+            g for g in tuning_mod.DECISION_GAUGES if g not in d["gauges"]
+        ]
+        if gmissing:
+            return f"decision gauges missing {gmissing}: {d}"
+    # replay invariant: the log IS the knob history — deltas must sum to
+    # the observed end state, or the log can't be trusted as evidence
+    final = doc.get("final") or stub.knobs()
+    for knob in initial:
+        delta = sum(
+            d["to"] - d["from"] for d in log if d["knob"] == knob
+        )
+        if initial[knob] + delta != final[knob]:
+            return (
+                f"decision log does not sum to the observed {knob} delta: "
+                f"{initial[knob]} + {delta} != {final[knob]}"
+            )
+    # (2) one real controller-on scan (tiny corpus, fast cadence)
+    rng = np.random.default_rng(11)
+    cfg = tuning_mod.TuningConfig(controller=True, tuning_interval=0.05)
+    scanner = TpuSecretScanner(tuning=cfg)
+    files = make_corpus(2, rng)
+    warm_buckets(scanner)
+    with obs.scan_context(name="smoke-controller", enabled=True) as ctx:
+        sum(len(s.findings) for s in scanner.scan_files(files))
+        tdoc = ctx.tuning_doc()
+    leaked = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith("tuning-controller")
+    ]
+    if leaked:
+        return f"controller thread(s) leaked after the scan: {leaked}"
+    ctl_doc = (tdoc or {}).get("controller")
+    if not ctl_doc or "initial" not in ctl_doc or "final" not in ctl_doc:
+        return (
+            f"controller-on scan exported no well-formed tuning block: "
+            f"{tdoc}"
+        )
+    return None
 
 
 def _smoke_client_mode() -> tuple[list[str], dict, str]:
@@ -1119,6 +1224,24 @@ def smoke(trace_out=None, metrics_out=None) -> int:
             file=sys.stderr,
         )
         return 1
+    # controller-off zero-cost: the untraced reps above ran with the
+    # controller off — they must have allocated exactly the configured
+    # stream workers (no parked controller headroom threads, no controller
+    # object); the thread-name sweep already proved no controller thread
+    feed_stats = getattr(scanner, "_last_feed_stats", {})
+    if feed_stats.get("streams") != scanner.feed_streams:
+        print(
+            f"FATAL: controller-off scan allocated "
+            f"{feed_stats.get('streams')} stream workers, expected exactly "
+            f"{scanner.feed_streams} (controller headroom must be "
+            f"zero-cost-when-off)",
+            file=sys.stderr,
+        )
+        return 1
+    ctl_err = _smoke_controller()
+    if ctl_err:
+        print(f"FATAL: {ctl_err}", file=sys.stderr)
+        return 1
     server_stages, client_profile, client_trace_id = _smoke_client_mode()
     if not server_stages:
         print(
@@ -1144,6 +1267,7 @@ def smoke(trace_out=None, metrics_out=None) -> int:
                 "profile_rules": len(profile["rules"]),
                 "counter_tracks": ts.names(),
                 "sampler_overhead_pct": round(overhead_pct, 2),
+                "tuning_controller": "ok",  # schema + zero-cost gates held
                 "client_mode": {
                     "trace_id": client_trace_id,
                     "server_stages": server_stages,
@@ -1154,6 +1278,104 @@ def smoke(trace_out=None, metrics_out=None) -> int:
             }
         )
     )
+    return 0
+
+
+# -- offline autotune (ROADMAP item 4, offline half) ------------------------
+
+# sweep axes: transfer streams x per-stream in-flight window — the two
+# knobs that decide link saturation (BASELINE.md r06 retune guidance). The
+# mini grid is the CI smoke's 2-point sanity sweep; the full grid is the
+# real per-topology search `bench.py --autotune` records.
+AUTOTUNE_GRID = [(s, i) for s in (1, 2, 4, 8) for i in (1, 2, 4)]
+AUTOTUNE_GRID_MINI = [(1, 1), (2, 2)]
+
+
+def autotune(out_path: str, mini: bool = False) -> int:
+    """``bench.py --autotune [--autotune-out PATH] [--autotune-mini]``:
+    sweep the stream/in-flight knob space over the e2e corpus on THIS
+    topology, record the optimum plus the measured surface into a
+    versioned AUTOTUNE.json keyed by topology fingerprint — later runs
+    (``TuningConfig`` via ``--tuning-file`` / ``TRIVY_TPU_TUNING_FILE`` /
+    ``./AUTOTUNE.json``) resolve unset knobs from it.
+
+    One scanner serves every point: stream count and window depth are
+    run-level knobs (``_ScanRun`` reads them per scan), so the sweep pays
+    kernel compiles once, not per grid point."""
+    from trivy_tpu import tuning as tuning_mod
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    rng = np.random.default_rng(42)
+    corpus_mb = int(os.environ.get(
+        "BENCH_AUTOTUNE_MB", "4" if mini else "16"
+    ))
+    files = make_corpus(corpus_mb, rng)
+    total_bytes = sum(len(d) for _, d in files)
+    topo = tuning_mod.topology_fingerprint()
+    scanner = TpuSecretScanner()
+    defaults = (scanner.feed_streams, scanner.inflight)
+    warm_buckets(scanner)
+    scanner.clear_hit_cache()
+    list(scanner.scan_files(files))  # untimed warm-up sweep-wide
+    points = AUTOTUNE_GRID_MINI if mini else AUTOTUNE_GRID
+    surface = []
+    best = None
+    try:
+        for streams, inflight in points:
+            scanner.feed_streams = streams
+            scanner.inflight = inflight
+            scanner.clear_hit_cache()
+            t0 = time.perf_counter()
+            n_findings = sum(
+                len(s.findings) for s in scanner.scan_files(files)
+            )
+            mbs = total_bytes / (time.perf_counter() - t0) / (1024 * 1024)
+            point = {
+                "feed_streams": streams,
+                "inflight": inflight,
+                "mbs": round(mbs, 2),
+                "findings": n_findings,
+            }
+            surface.append(point)
+            print(
+                f"autotune {topo}: streams={streams} inflight={inflight} "
+                f"-> {mbs:.2f} MB/s",
+                file=sys.stderr,
+            )
+            if best is None or mbs > best["mbs"]:
+                best = point
+    finally:
+        scanner.feed_streams, scanner.inflight = defaults
+    tuning_mod.save_autotune(
+        out_path, topo,
+        {"feed_streams": best["feed_streams"], "inflight": best["inflight"]},
+        surface,
+        meta={
+            "corpus_mb": corpus_mb,
+            "headline_mbs": best["mbs"],
+            "grid": "mini" if mini else "full",
+        },
+    )
+    # round-trip gate: the record just written must load back for THIS
+    # fingerprint — an unloadable record is a silent no-op on every
+    # future run, exactly what this mode exists to prevent
+    if tuning_mod.load_autotune(out_path, topo) is None:
+        print(
+            f"FATAL: {out_path} does not load back for topology {topo}",
+            file=sys.stderr,
+        )
+        return 1
+    print(json.dumps({
+        "metric": "bench_autotune",
+        "topology": topo,
+        "best": best,
+        "points": len(surface),
+        "default_mbs": next(
+            (p["mbs"] for p in surface
+             if (p["feed_streams"], p["inflight"]) == defaults), None
+        ),
+        "out": out_path,
+    }))
     return 0
 
 
@@ -1226,6 +1448,26 @@ def _metric_values(doc: dict) -> dict:
     return out
 
 
+# knobs compared for the drift annotation (the scalar TuningConfig set;
+# bucket_ladder is a list and prints poorly, so its depth rides arena row)
+_DRIFT_KNOBS = ("feed_streams", "inflight", "arena_slabs", "controller")
+
+
+def _tuning_drift(prev_doc: dict, cur_doc: dict) -> dict:
+    """Knob-value differences between two rounds' effective-tuning
+    snapshots (``detail.tuning``), {} when either round predates them."""
+    pt = (prev_doc.get("detail") or {}).get("tuning") or {}
+    ct = (cur_doc.get("detail") or {}).get("tuning") or {}
+    if not pt or not ct:
+        return {}
+    out = {}
+    for k in _DRIFT_KNOBS:
+        pv, cv = pt.get(k), ct.get(k)
+        if pv != cv:
+            out[k] = {"prev": pv, "cur": cv}
+    return out
+
+
 def check_regression(prev_path: str, cur_path: str,
                      threshold: float = REGRESSION_THRESHOLD,
                      cur_doc: dict | None = None, report_out=None) -> int:
@@ -1238,10 +1480,10 @@ def check_regression(prev_path: str, cur_path: str,
     the newest ``BENCH_r*.json`` (pass ``cur_doc`` for the in-memory
     current run), so a perf regression fails at PR time instead of being
     discovered at the next re-anchor."""
-    prev = _metric_values(_load_bench_doc(prev_path))
-    cur = _metric_values(
-        cur_doc if cur_doc is not None else _load_bench_doc(cur_path)
-    )
+    prev_full = _load_bench_doc(prev_path)
+    cur_full = cur_doc if cur_doc is not None else _load_bench_doc(cur_path)
+    prev = _metric_values(prev_full)
+    cur = _metric_values(cur_full)
     cur_path = cur_path or "<current run>"
     if "secret_scan_e2e_throughput" not in prev:
         print(f"FATAL: {prev_path}: no secret_scan_e2e_throughput metric",
@@ -1254,6 +1496,24 @@ def check_regression(prev_path: str, cur_path: str,
     headline_fell = (
         cur["secret_scan_e2e_throughput"] < prev["secret_scan_e2e_throughput"]
     )
+    # metric-set drift is a SKIP, never a crash, and never silent: a prior
+    # round that predates a metric introduced later (r05 rounds lack
+    # link_mbs_p50) must not false-fail fresh rounds — but the operator
+    # must see which comparisons didn't happen
+    skipped_new = sorted(set(cur) - set(prev))
+    skipped_gone = sorted(set(prev) - set(cur))
+    for name in skipped_new:
+        print(
+            f"WARNING: metric {name} skipped: prior round {prev_path} "
+            f"predates it",
+            file=sys.stderr,
+        )
+    for name in skipped_gone:
+        print(
+            f"WARNING: metric {name} skipped: current run does not "
+            f"report it (present in {prev_path})",
+            file=sys.stderr,
+        )
     rows = []
     regressions = []
     for name in sorted(prev):
@@ -1271,18 +1531,35 @@ def check_regression(prev_path: str, cur_path: str,
             bad = False  # efficiency win: less link/device per byte
         if bad:
             regressions.append((name, pv, cv, delta))
-    # the auto-gate inside `python bench.py` reports on stderr so stdout
-    # stays ONE parseable headline doc (the contract _load_bench_doc and
-    # `bench.py > BENCH_rNN.json` round captures rely on); the explicit
-    # --check-regression mode keeps stdout
-    print(json.dumps({
+    # knob-drift annotation: when both rounds carry an effective-tuning
+    # snapshot, surface any knob whose value changed — a throughput delta
+    # next to a stream-count change reads very differently from one at
+    # constant knobs (annotation only; drift is information, not failure)
+    drift = _tuning_drift(prev_full, cur_full)
+    if drift:
+        print(
+            f"NOTE: tuning knob drift vs {prev_path}: " + ", ".join(
+                f"{k} {v['prev']} -> {v['cur']}" for k, v in drift.items()
+            ),
+            file=sys.stderr,
+        )
+    doc_out = {
         "metric": "bench_regression_check",
         "prev": prev_path,
         "cur": cur_path,
         "threshold_pct": round(threshold * 100, 1),
         "rows": rows,
         "regressions": [r[0] for r in regressions],
-    }), file=report_out or sys.stdout)
+        "skipped": {"new_in_current": skipped_new,
+                    "absent_in_current": skipped_gone},
+    }
+    if drift:
+        doc_out["tuning_drift"] = drift
+    # the auto-gate inside `python bench.py` reports on stderr so stdout
+    # stays ONE parseable headline doc (the contract _load_bench_doc and
+    # `bench.py > BENCH_rNN.json` round captures rely on); the explicit
+    # --check-regression mode keeps stdout
+    print(json.dumps(doc_out), file=report_out or sys.stdout)
     for name, pv, cv, delta in regressions:
         print(
             f"FATAL: {name} regressed {abs(delta) * 100:.1f}% "
@@ -1373,6 +1650,11 @@ def main():
             "backend": scanner.backend,
             "feed_streams": scanner.feed_streams,
             "feed_inflight": scanner.inflight,
+            # effective-knob snapshot (post-resolution TuningConfig plus
+            # the values the last scan actually ran with): rounds tuned
+            # differently stay comparable, and --check-regression
+            # annotates knob drift alongside any throughput change
+            "tuning": scanner.tuning_snapshot(),
             "device_kernel_mbs": round(device_mbs, 2),
             "cpu_engine_mbs": cpu["cpu_engine_mbs"],
             "device_speedup": round(
@@ -1442,6 +1724,11 @@ if __name__ == "__main__":
         sys.exit(smoke(_cli_opt("--trace-out"), _cli_opt("--metrics-out")))
     elif "--chaos" in sys.argv:
         sys.exit(chaos())
+    elif "--autotune" in sys.argv:
+        sys.exit(autotune(
+            _cli_opt("--autotune-out") or "AUTOTUNE.json",
+            mini="--autotune-mini" in sys.argv,
+        ))
     elif "--check-regression" in sys.argv:
         prev = _cli_opt("--check-regression")
         cur = _cli_opt("--against") or _latest_bench_json()

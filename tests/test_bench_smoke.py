@@ -153,6 +153,100 @@ def test_bench_check_regression_gate(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_autotune_mini_sweep_produces_loadable_record(tmp_path):
+    """bench.py --autotune --autotune-mini: the 2-point grid must run both
+    points, pick a best, and write an AUTOTUNE.json that loads back for
+    this topology fingerprint (an unloadable record is a silent no-op on
+    every future run)."""
+    out = tmp_path / "AUTOTUNE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_AUTOTUNE_MB="2")
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--autotune", "--autotune-mini",
+         "--autotune-out", str(out)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=600,
+    )
+    assert p.returncode == 0, f"stdout={p.stdout}\nstderr={p.stderr}"
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert doc["metric"] == "bench_autotune"
+    assert doc["points"] == 2
+    assert doc["best"]["mbs"] > 0
+    from trivy_tpu import tuning
+
+    rec = tuning.load_autotune(str(out), doc["topology"])
+    assert rec is not None
+    assert rec["best"]["feed_streams"] >= 1
+    assert len(rec["surface"]) == 2
+    # and the record actually steers a resolution for that topology
+    cfg = tuning.resolve_tuning(
+        opts={}, env={}, autotune_path=str(out), topology=doc["topology"]
+    )
+    assert cfg.feed_streams == rec["best"]["feed_streams"]
+    assert cfg.source["feed_streams"] == "autotune"
+
+
+def test_bench_check_regression_skips_loudly_on_metric_drift(tmp_path):
+    """A prior round that predates a metric introduced later (the r05
+    rounds lack link_mbs_p50) must SKIP that comparison loudly — warning
+    on stderr, listed in the report doc — and never crash or false-fail
+    the fresh round."""
+    prev = tmp_path / "prev.json"
+    prev.write_text(json.dumps(_bench_doc(10.0)))  # no telemetry metrics
+    cur_doc = _bench_doc(10.5)
+    cur_doc["detail"]["link_mbs_p50"] = 9.0
+    cur_doc["detail"]["device_busy_ratio"] = 0.8
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(cur_doc))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--check-regression", str(prev),
+         "--against", str(cur)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert "link_mbs_p50" in doc["skipped"]["new_in_current"]
+    assert "device_busy_ratio" in doc["skipped"]["new_in_current"]
+    assert "link_mbs_p50" in p.stderr and "predates it" in p.stderr
+    # and the reverse direction (metric vanished) is loud too
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--check-regression", str(cur),
+         "--against", str(prev)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert "link_mbs_p50" in doc["skipped"]["absent_in_current"]
+
+
+def test_bench_check_regression_annotates_knob_drift(tmp_path):
+    """Rounds carrying effective-tuning snapshots get a knob-drift NOTE
+    (annotation, never a failure) when the knob set changed between them."""
+    prev_doc = _bench_doc(10.0)
+    prev_doc["detail"]["tuning"] = {"feed_streams": 2, "inflight": 2}
+    cur_doc = _bench_doc(12.0)
+    cur_doc["detail"]["tuning"] = {"feed_streams": 4, "inflight": 2}
+    prev = tmp_path / "prev.json"
+    prev.write_text(json.dumps(prev_doc))
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(cur_doc))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, "bench.py", "--check-regression", str(prev),
+         "--against", str(cur)],
+        capture_output=True, text=True, env=env, cwd="/root/repo",
+        timeout=120,
+    )
+    assert p.returncode == 0, p.stderr
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert doc["tuning_drift"]["feed_streams"] == {"prev": 2, "cur": 4}
+    assert "inflight" not in doc["tuning_drift"]
+    assert "knob drift" in p.stderr
+
+
+@pytest.mark.slow
 def test_bench_check_regression_reads_wrapped_bench_json(tmp_path):
     """Driver-wrapped BENCH_*.json ({"tail": "...{json}"}) parses too, so
     the gate runs directly against the repo's recorded rounds."""

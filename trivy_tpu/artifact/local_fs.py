@@ -138,7 +138,13 @@ class LocalFSArtifact:
         # own streaming handoff, so the walk, the reads, and the device
         # pipeline all overlap; the read-ahead window is the walk-side
         # bound, the analyzer's stream budget the device-side one.
-        workers = self.option.parallel or DEFAULT_PARALLEL
+        # read-ahead sizing shares the consolidated TuningConfig with the
+        # device feed (same precedence chain: --parallel > env > autotune
+        # record > DEFAULT_PARALLEL), so an offline sweep that found the
+        # read pool to be the binding constraint steers this too
+        tuning = (self.option.analyzer_extra or {}).get("tuning")
+        tuned_parallel = getattr(tuning, "parallel", 0) if tuning else 0
+        workers = self.option.parallel or tuned_parallel or DEFAULT_PARALLEL
         prefetch_files = max(self.PREFETCH_FILES, workers * 16)
         try:
             with obs.heartbeat(

@@ -17,6 +17,14 @@ from trivy_tpu.flag import Flag, FlagGroup, load_config_file, resolve_all
 
 VERSION = "0.1.0"
 
+
+def _interval_validator(v):
+    # reject negative/NaN/inf cadences at flag-resolution time (tuning.py
+    # owns the rule; the Flag layer prefixes the flag name on failure)
+    from trivy_tpu.tuning import validate_interval
+
+    return validate_interval(v, "interval")
+
 SCANNERS = ["vuln", "misconfig", "secret", "license"]
 FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json", "github", "template", "cosign-vuln"]
 
@@ -65,6 +73,7 @@ def global_flags() -> FlagGroup:
                       ".gz path gzips)"),
             Flag("telemetry-interval", default=None, value_type=float,
                  config_name="telemetry.interval",
+                 validator=_interval_validator,
                  help="live-telemetry sampling interval in seconds "
                       "(default 0.25; 0 disables the sampler entirely)"),
             Flag("timeseries-out", default=None,
@@ -181,6 +190,40 @@ def secret_flags() -> FlagGroup:
                  config_name="secret.no-shared-arena",
                  help="disable the fused secret+license device pass "
                       "(license gram rows then upload separately)"),
+            Flag("secret-arena-slabs", default=0, value_type=int,
+                 config_name="secret.arena-slabs",
+                 help="chunk-arena slab count for the device feed "
+                      "(0 = derived from streams x in-flight windows)"),
+            Flag("secret-bucket-rungs", default=0, value_type=int,
+                 config_name="secret.bucket-rungs",
+                 help="dispatch bucket-ladder depth (0 = default 3: "
+                      "B, B/2, B/4; each rung costs one kernel compile)"),
+        ],
+    )
+
+
+def tuning_flags() -> FlagGroup:
+    """The telemetry→tuning loop (README "Autotuning"): offline records
+    and the online mid-scan controller."""
+    return FlagGroup(
+        "tuning",
+        [
+            Flag("tuning-file", default=None, config_name="tuning.file",
+                 help="AUTOTUNE.json with per-topology swept optima "
+                      "(written by `bench.py --autotune`; default: "
+                      "./AUTOTUNE.json when present). Unset knobs resolve "
+                      "from the record for this topology fingerprint"),
+            Flag("tune", default=False, value_type=bool,
+                 config_name="tuning.controller",
+                 help="enable the online tuning controller: adapt stream "
+                      "count / in-flight windows / arena sizing mid-scan "
+                      "from live gauge feedback (every decision is logged "
+                      "and exported — see README 'Autotuning')"),
+            Flag("tuning-interval", default=None, value_type=float,
+                 config_name="tuning.interval",
+                 validator=_interval_validator,
+                 help="online-controller decision cadence in seconds "
+                      "(default 0.5; 0 disables the controller)"),
         ],
     )
 
@@ -270,16 +313,19 @@ def server_client_flags() -> FlagGroup:
 
 _TARGET_GROUPS = {
     "fs": [global_flags, scan_flags, report_flags, secret_flags, license_flags,
-           misconf_flags, db_flags, server_client_flags],
+           misconf_flags, db_flags, server_client_flags, tuning_flags],
     "rootfs": [global_flags, scan_flags, report_flags, secret_flags,
-               license_flags, misconf_flags, db_flags, server_client_flags],
+               license_flags, misconf_flags, db_flags, server_client_flags,
+               tuning_flags],
     "repo": [global_flags, scan_flags, report_flags, secret_flags,
-             license_flags, misconf_flags, db_flags, server_client_flags],
+             license_flags, misconf_flags, db_flags, server_client_flags,
+             tuning_flags],
     "image": [global_flags, scan_flags, report_flags, secret_flags,
               license_flags, misconf_flags, db_flags, server_client_flags,
-              image_flags],
+              image_flags, tuning_flags],
     "vm": [global_flags, scan_flags, report_flags, secret_flags,
-           license_flags, misconf_flags, db_flags, server_client_flags],
+           license_flags, misconf_flags, db_flags, server_client_flags,
+           tuning_flags],
     "sbom": [global_flags, scan_flags, report_flags, db_flags,
              server_client_flags],
     "convert": [global_flags, report_flags],

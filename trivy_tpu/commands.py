@@ -31,6 +31,31 @@ def _make_cache(opts):
     return new_cache(backend, opts.get("cache_dir"), **kwargs)
 
 
+def _resolve_tuning(opts):
+    """One TuningConfig per run (CLI > env > autotune record > topology
+    default), shared by the secret feed, the artifact read-ahead, and the
+    online controller — and registered on the scan context so every export
+    surface (--metrics-out, --trace-out, Trace responses) carries the
+    effective knob set."""
+    from trivy_tpu import obs
+    from trivy_tpu.tuning import resolve_tuning
+
+    cfg = resolve_tuning(opts={
+        "secret_streams": opts.get("secret_streams"),
+        "secret_inflight": opts.get("secret_inflight"),
+        "secret_arena_slabs": opts.get("secret_arena_slabs"),
+        "secret_bucket_rungs": opts.get("secret_bucket_rungs"),
+        "parallel": opts.get("parallel"),
+        "tuning_file": opts.get("tuning_file"),
+        # the store_true default (False) must not shadow the env layer:
+        # only an EXPLICIT --tune is a CLI-level decision
+        "tuning_controller": opts.get("tune") or None,
+        "tuning_interval": opts.get("tuning_interval"),
+    })
+    obs.current().tuning = {"config": cfg.to_dict()}
+    return cfg
+
+
 def _artifact_option(ns, opts):
     from trivy_tpu.artifact.local_fs import ArtifactOption
 
@@ -80,6 +105,7 @@ def _artifact_option(ns, opts):
         fused_license = FusedLicenseGate(
             license_full=bool(opts.get("license_full"))
         )
+    tuning = _resolve_tuning(opts)
     return ArtifactOption(
         skip_files=opts.get("skip_files", []),
         skip_dirs=opts.get("skip_dirs", []),
@@ -87,6 +113,10 @@ def _artifact_option(ns, opts):
         secret_config_path=secret_cfg,
         backend=device_backend,
         analyzer_extra={
+            # the consolidated knob config (CLI > env > autotune record >
+            # topology default): the secret scanner, the fs read-ahead,
+            # and the online controller all read this one object
+            "tuning": tuning,
             "check_paths": list(opts.get("config_check") or []),
             "misconfig_scanners": list(opts.get("misconfig_scanners") or []),
             "parallel": max(0, int(opts.get("parallel") or 0)),

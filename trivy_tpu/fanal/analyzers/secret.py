@@ -46,13 +46,23 @@ def _shared_scanner(
     config, backend: str, parallel: int,
     dedup: bool = True, pack_small: bool = True, hit_cache=None,
     host_fallback: bool = True, feed_streams: int = 0, inflight: int = 0,
-    prefilter: bool = True,
+    prefilter: bool = True, tuning=None,
 ):
+    # the resolved TuningConfig participates in the cache key by VALUE:
+    # two scans tuned differently must not share one compiled scanner's
+    # stream topology (same fields, same scanner — autotune records make
+    # this common)
+    tuning_key = None
+    if tuning is not None:
+        tuning_key = (
+            tuning.feed_streams, tuning.inflight, tuning.arena_slabs,
+            tuning.bucket_rungs, tuning.controller, tuning.tuning_interval,
+        )
     key = (
         id(config) if config is not None else None,
         backend, parallel, dedup, pack_small,
         id(hit_cache) if hit_cache is not None else None,
-        host_fallback, feed_streams, inflight, prefilter,
+        host_fallback, feed_streams, inflight, prefilter, tuning_key,
     )
     with _scanner_lock:
         if key not in _scanner_cache:
@@ -68,7 +78,7 @@ def _shared_scanner(
                         dedup=dedup, pack_small=pack_small,
                         hit_cache=hit_cache, host_fallback=host_fallback,
                         feed_streams=feed_streams, inflight=inflight,
-                        prefilter=prefilter,
+                        prefilter=prefilter, tuning=tuning,
                     )
                 except Exception as e:
                     # --backend failed at init (jax import, device probe,
@@ -211,6 +221,11 @@ class SecretAnalyzer(BatchAnalyzer):
         # async feed-path knobs (--secret-streams / --secret-inflight)
         self._feed_streams = int(extra.get("secret_streams", 0) or 0)
         self._inflight = int(extra.get("secret_inflight", 0) or 0)
+        # the consolidated TuningConfig (commands.py resolves the full
+        # CLI > env > autotune > topology chain once per run); the legacy
+        # per-knob extras above stay as explicit overrides for library
+        # callers that never touch the flag layer
+        self._tuning = extra.get("tuning")
         # --no-secret-prefilter opts out of the on-device keyword pass
         self._prefilter = bool(extra.get("secret_prefilter", True))
         # fused license gate (shared-arena pass), created by commands.py
@@ -259,7 +274,7 @@ class SecretAnalyzer(BatchAnalyzer):
                 hit_cache=self._hit_cache,
                 host_fallback=self._host_fallback,
                 feed_streams=self._feed_streams, inflight=self._inflight,
-                prefilter=self._prefilter,
+                prefilter=self._prefilter, tuning=self._tuning,
             )
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 

@@ -26,6 +26,11 @@ class Flag:
     value_type: type = str
     is_list: bool = False
     short: str | None = None
+    # post-coercion validator: called with the coerced value, raises
+    # ValueError to reject — bad input (negative intervals, NaN cadences)
+    # fails AT FLAG RESOLUTION with a usage error instead of reaching the
+    # subsystem that would silently misbehave on it
+    validator: Any = None
 
     @property
     def env_name(self) -> str:
@@ -67,7 +72,15 @@ class Flag:
                 raw = node
         if raw is None:
             return self.default
-        return self._coerce(raw)
+        value = self._coerce(raw)
+        if self.validator is not None:
+            try:
+                normalized = self.validator(value)
+            except ValueError as e:
+                raise ValueError(f"--{self.name}: {e}") from None
+            if normalized is not None:
+                value = normalized
+        return value
 
     def _coerce(self, raw: Any) -> Any:
         if self.is_list:

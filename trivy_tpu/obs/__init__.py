@@ -225,6 +225,12 @@ class TraceContext:
         # live telemetry (obs/timeseries.py): the scan's bounded time
         # series, set by an attached Sampler; None on unsampled scans
         self.timeseries = None
+        # tuning surface (trivy_tpu/tuning.py): the resolved knob config
+        # (a plain dict, set by the command layer) and the attached online
+        # controller (exposes .doc(), set by the pipeline when the
+        # controller is on) — tuning_doc() merges both for export
+        self.tuning: dict | None = None
+        self.tuning_controller = None
         # always-on scan progress (bytes/files walked vs scanned), created
         # lazily by progress() — like health, NOT gated on `enabled`
         self._progress = None
@@ -368,6 +374,22 @@ class TraceContext:
                 )
         return out
 
+    def tuning_doc(self) -> dict | None:
+        """The scan's tuning state for export: the resolved knob config
+        (with per-knob provenance) plus — when an online controller is
+        attached — its decision log snapshot. None when neither exists, so
+        pre-tuning consumers see no empty block."""
+        ctl = self.tuning_controller
+        if self.tuning is None and ctl is None:
+            return None
+        doc = dict(self.tuning or {})
+        if ctl is not None:
+            try:
+                doc["controller"] = ctl.doc()
+            except Exception:  # a dying controller must not kill export
+                pass
+        return doc
+
     def merged_profile_dict(self) -> dict:
         """Local profile plus every joined remote profile as one dict —
         what ``--profile-out`` writes and the report table renders."""
@@ -435,6 +457,8 @@ class TraceContext:
             self._progress = None
             self._probes.clear()
             self.timeseries = None
+            self.tuning = None
+            self.tuning_controller = None
 
     # -- aggregation --------------------------------------------------------
 
@@ -760,6 +784,28 @@ class heartbeat:
         parts = [f"{snap['ratio'] * 100:.1f}%", f"{mbs:.1f} MB/s"]
         if snap.get("eta_s") is not None:
             parts.append(f"ETA {snap['eta_s']:.0f}s")
+        # effective-knob fragment: the live values when a controller is
+        # adapting them, else the resolved config — so beats from two
+        # differently-tuned scans stay comparable in the logs
+        knobs = None
+        ctl = ctx.tuning_controller if ctx is not None else None
+        if ctl is not None:
+            try:
+                knobs = ctl.adapter.knobs()
+            except Exception:
+                knobs = None
+        elif ctx is not None and isinstance(ctx.tuning, dict):
+            cfg = ctx.tuning.get("config") or {}
+            if cfg.get("feed_streams") or cfg.get("inflight"):
+                knobs = cfg
+        if knobs:
+            frag = f"knobs s{knobs.get('feed_streams', 0)}" \
+                   f"/i{knobs.get('inflight', 0)}"
+            if knobs.get("arena_slabs"):
+                frag += f"/a{knobs['arena_slabs']}"
+            if ctl is not None:
+                frag += f" ({len(ctl.decisions)} decisions)"
+            parts.append(frag)
         return " [" + ", ".join(parts) + "]"
 
     def _loop(self) -> None:

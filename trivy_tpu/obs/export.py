@@ -123,6 +123,11 @@ def context_doc(ctx: TraceContext, max_events: int = WIRE_MAX_EVENTS) -> dict:
         # live-telemetry series ride the wire too (bounded), so a merged
         # client export carries the server's counter tracks
         doc["timeseries"] = ts.to_doc()
+    tuning = ctx.tuning_doc()
+    if tuning is not None:
+        # resolved knobs + the controller decision log: a client-mode scan
+        # can replay the SERVER's mid-scan adaptations from its own export
+        doc["tuning"] = tuning
     return doc
 
 
@@ -201,6 +206,32 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
                     }
                 )
 
+    def emit_tuning(pid: int, tuning: dict, base_us: float = 0.0) -> None:
+        """Online-controller decisions as Perfetto INSTANT events
+        (``"ph": "i"``, process-scoped): each carries the rule, the knob
+        delta, and the gauge snapshot that fired it, landing on the same
+        timeline as the knob-value counter tracks — so an operator can
+        point at any mid-scan knob step and read why."""
+        ctl = tuning.get("controller") or {}
+        for d in ctl.get("decision_log", ()):
+            events.append(
+                {
+                    "name": f"tuning:{d.get('rule', '?')}",
+                    "cat": "tuning",
+                    "ph": "i",
+                    "s": "p",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": max(0.0, round(base_us + d.get("t", 0.0) * 1e6, 3)),
+                    "args": {
+                        "knob": d.get("knob"),
+                        "from": d.get("from"),
+                        "to": d.get("to"),
+                        "gauges": d.get("gauges", {}),
+                    },
+                }
+            )
+
     with ctx._lock:
         spans = list(ctx.events)
         remote_docs = list(ctx.remote)
@@ -212,6 +243,9 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
         )
     if ts is not None:
         emit_counters(1, ts.to_doc())
+    local_tuning = ctx.tuning_doc()
+    if local_tuning is not None:
+        emit_tuning(1, local_tuning)
     for i, doc in enumerate(remote_docs):
         pid = 2 + i
         events.append(
@@ -248,6 +282,8 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
             )
         if doc.get("timeseries"):
             emit_counters(pid, doc["timeseries"], base_us)
+        if doc.get("tuning"):
+            emit_tuning(pid, doc["tuning"], base_us)
     return events
 
 
@@ -303,6 +339,12 @@ def metrics_dict(ctx: TraceContext) -> dict:
         doc["timeseries"] = ctx.timeseries.summary()
     if ctx._progress is not None:
         doc["progress"] = ctx._progress.snapshot()
+    tuning = ctx.tuning_doc()
+    if tuning is not None:
+        # effective knobs + decision log: --metrics-out consumers (and the
+        # bench reps embedding this dict) see WHAT the scan ran with and
+        # every mid-scan change the controller made
+        doc["tuning"] = tuning
     if remote_docs:
         doc["remote"] = [
             {
@@ -362,6 +404,9 @@ def timeseries_dict(ctx: TraceContext) -> dict:
     }
     if prog is not None:
         doc["progress"] = prog.snapshot()
+    tuning = ctx.tuning_doc()
+    if tuning is not None:
+        doc["tuning"] = tuning
     remote = [
         {
             "trace_id": d.get("trace_id"),

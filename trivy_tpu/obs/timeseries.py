@@ -66,13 +66,15 @@ _BUSY_RE = re.compile(r"^device\.(d\w+)\.busy_seconds_total$")
 
 def default_interval() -> float:
     """Sampler cadence from ``TRIVY_TPU_TELEMETRY_INTERVAL`` (seconds),
-    falling back to :data:`DEFAULT_INTERVAL`; 0 disables."""
+    falling back to :data:`DEFAULT_INTERVAL`; 0 disables. Negative, NaN,
+    infinite, or non-numeric values raise — a silently-swallowed typo used
+    to hand the sampler a degenerate cadence (always-default, or a
+    busy-spinning thread) the user only saw in the symptoms."""
     raw = os.environ.get("TRIVY_TPU_TELEMETRY_INTERVAL", "")
     if raw:
-        try:
-            return max(0.0, float(raw))
-        except ValueError:
-            pass
+        from trivy_tpu.tuning import validate_interval
+
+        return validate_interval(raw, "TRIVY_TPU_TELEMETRY_INTERVAL")
     return DEFAULT_INTERVAL
 
 
@@ -535,6 +537,18 @@ class LiveProgress:
             free = ts.latest("secret.arena_free_slabs")
             if free is not None:
                 parts.append(f"arena free {free:.0f}")
+        # online-tuning column: current knob set + decision count, so an
+        # operator watching --live sees every mid-scan adaptation land
+        ctl = getattr(self.ctx, "tuning_controller", None)
+        if ctl is not None:
+            try:
+                k = ctl.adapter.knobs()
+                parts.append(
+                    f"tune s{k['feed_streams']}/i{k['inflight']} "
+                    f"({len(ctl.decisions)} dec)"
+                )
+            except Exception:
+                pass
         return "scan " + " | ".join(parts) if parts else "scan starting..."
 
     def start(self) -> "LiveProgress":

@@ -49,6 +49,28 @@ _DEVICE_FAILURES = obs_metrics.REGISTRY.counter(
 )
 
 
+def link_class(platform: str | None = None) -> str:
+    """Coarse host→device link classification for the tuning topology
+    fingerprint (``TuningConfig`` autotune records are keyed by device
+    kind/count + this): ``host`` for CPU-backend virtual devices (one
+    memory bus, no real link), ``tunnel`` when the axon remote-transfer
+    tunnel is in play (a serialized ~10 MB/s link whose optimum knobs are
+    nothing like local PCIe's), ``pcie`` otherwise. Override with
+    ``TRIVY_TPU_LINK_CLASS`` when the heuristic misreads a deployment."""
+    import os
+
+    override = os.environ.get("TRIVY_TPU_LINK_CLASS", "")
+    if override:
+        return override
+    if platform is None:
+        platform = jax.devices()[0].platform
+    if platform in ("cpu", "METAL"):
+        return "host"
+    if any(k.startswith("AXON_") for k in os.environ):
+        return "tunnel"
+    return "pcie"
+
+
 class DevicesUnavailable(RuntimeError):
     """Every dispatch device is circuit-broken (or the device set is empty):
     the caller's last rung is the host fallback, not a retry."""

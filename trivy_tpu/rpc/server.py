@@ -207,6 +207,10 @@ class ScanServer:
 
         self.cache = cache
         self.driver = LocalDriver(cache, vuln_client=vuln_client)
+        # validate the telemetry cadence once at construction: a garbage
+        # TRIVY_TPU_TELEMETRY_INTERVAL must kill the server at boot with a
+        # clear error, not every scan request with a 500
+        self.telemetry_interval = obs_timeseries.default_interval()
         self.reloader: DBReloader | None = None
         self.metrics = ServerMetrics()
         self.started = time.time()
@@ -272,7 +276,16 @@ class ScanServer:
             # GET /scan/<trace_id>/progress while this request runs
             progress = ctx.progress()
             self._progress_register(ctx.trace_id, progress)
-            sampler = obs_timeseries.start_sampler(ctx)
+            # per-request sampler at the cadence validated ONCE at server
+            # construction — a garbage TRIVY_TPU_TELEMETRY_INTERVAL fails
+            # at boot, not as a 500 on the Nth scan request. (No tuning
+            # block is exported here: the server half runs detection over
+            # cached blobs, never the device feed, so it has no effective
+            # knob set to honestly report — the client's export carries
+            # its own.)
+            sampler = obs_timeseries.start_sampler(
+                ctx, self.telemetry_interval
+            )
             try:
                 with obs.heartbeat(
                     logger, f"scan of {target or '<unnamed>'}", HEARTBEAT_SECS
@@ -451,13 +464,30 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 return
             m = server.metrics
             m.in_flight.inc()
-            self._status = 0
             t0 = time.perf_counter()
+            try:
+                code, payload = self._dispatch(method)
+            finally:
+                # EVERY piece of request accounting (in-flight gauge,
+                # request counter, latency histogram) finalizes BEFORE the
+                # reply hits the wire: a client that reads its response
+                # and immediately scrapes /metrics must see this request
+                # completed — not a stale in-flight 1 or a missing count
+                # from bookkeeping racing the socket write
+                m.in_flight.dec()
+            m.requests.inc(method=method, code=str(code))
+            m.request_seconds.observe(
+                time.perf_counter() - t0, method=method
+            )
+            self._reply(code, payload)
+
+        def _dispatch(self, method) -> tuple[int, dict]:
+            """Run one RPC method; returns (status, payload) and never
+            raises — the reply and the request metrics are the caller's."""
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 if length < 0 or length > MAX_REQUEST_BYTES:
-                    self._reply(413, {"error": "request too large"})
-                    return
+                    return 413, {"error": "request too large"}
                 raw = self.rfile.read(length)
                 if self.headers.get("Content-Encoding") == "gzip":
                     import gzip as _gzip
@@ -468,8 +498,7 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                     with _gzip.GzipFile(fileobj=_io.BytesIO(raw)) as gz:
                         raw = gz.read(MAX_REQUEST_BYTES + 1)
                     if len(raw) > MAX_REQUEST_BYTES:
-                        self._reply(413, {"error": "request too large"})
-                        return
+                        return 413, {"error": "request too large"}
                 req = json.loads(raw or b"{}")
                 reloader = server.reloader
                 if reloader is not None:
@@ -484,18 +513,12 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 finally:
                     if reloader is not None:
                         reloader.request_end()
-                self._reply(200, resp)
+                return 200, resp
             except KeyError as e:
-                self._reply(400, {"error": f"bad request: {e}"})
+                return 400, {"error": f"bad request: {e}"}
             except Exception as e:
                 logger.warning("rpc %s failed: %s", self.path, e)
-                self._reply(500, {"error": str(e)})
-            finally:
-                m.in_flight.dec()
-                m.requests.inc(method=method, code=str(self._status))
-                m.request_seconds.observe(
-                    time.perf_counter() - t0, method=method
-                )
+                return 500, {"error": str(e)}
 
     return Handler
 

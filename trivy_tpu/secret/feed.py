@@ -100,6 +100,25 @@ class ChunkArena:
             self._free.append(slab_id)
             self._cond.notify()
 
+    def grow(self, n: int, max_slabs: int | None = None) -> int:
+        """Add up to ``n`` fresh slabs (online tuning: a controller that
+        raises the stream count or window depth grows the pool so slab
+        backpressure doesn't starve the new capacity), bounded by
+        ``max_slabs``. Returns the new slab count. Growth only — slabs may
+        be in flight at any moment, so shrinking would mean tracking
+        retirement; the bound comes from the controller's limits."""
+        with self._cond:
+            if max_slabs is not None:
+                n = min(n, max_slabs - self.n_slabs)
+            for _ in range(max(0, n)):
+                self._bufs.append(
+                    np.zeros((self.rows, self.row_len), dtype=np.uint8)
+                )
+                self._free.append(len(self._bufs) - 1)
+                self.n_slabs += 1
+            self._cond.notify_all()
+            return self.n_slabs
+
     @property
     def free_slabs(self) -> int:
         with self._cond:

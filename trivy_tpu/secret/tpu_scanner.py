@@ -272,8 +272,28 @@ class TpuSecretScanner:
         inflight: int = 0,  # in-flight batches per stream; 0 = FEED_INFLIGHT
         prefilter: bool = True,  # on-device keyword prefilter first pass
         # (--no-secret-prefilter); auto-disabled when no rule has keywords
+        tuning=None,  # trivy_tpu.tuning.TuningConfig; None = env-resolved
+        # defaults (no implicit AUTOTUNE.json discovery — the CLI layer
+        # resolves the full CLI > env > autotune > topology chain and
+        # passes the result here; library callers stay hermetic)
+        arena_slabs: int = 0,  # chunk-arena slab override; 0 = derived
+        bucket_rungs: int = 0,  # dispatch bucket-ladder depth; 0 = default
     ):
         import jax
+
+        from trivy_tpu.tuning import resolve_tuning, topology_fingerprint
+
+        # the consolidated knob config (ROADMAP item 4): explicit ctor args
+        # are the strongest layer (tests/bench pass them directly), then the
+        # TuningConfig's own CLI > env > autotune > topology-default chain.
+        # Fingerprinting here is free — this ctor initializes jax anyway
+        if tuning is None:
+            tuning = resolve_tuning(
+                autotune_path="", topology=topology_fingerprint()
+            )
+        elif not tuning.topology:
+            tuning.topology = topology_fingerprint()
+        self.tuning = tuning
 
         self.exact = SecretScanner(config)
         self.compiled: CompiledRules = compile_rules(self.exact.rules)
@@ -431,15 +451,15 @@ class TpuSecretScanner:
             _compat_match.devices = rr_devices
         self._match = _compat_match
 
-        # transfer-stream sizing: one worker thread per round-robin device
-        # (per-device copies overlap each other), several streams on one
-        # accelerator (concurrent device_puts are the only way past a
-        # serialized tunnel link), two on the CPU backend (keeps the async
-        # machinery exercised in tests without thrashing one memory bus)
+        # transfer-stream sizing: explicit ctor arg > TuningConfig (which
+        # folds CLI/env/autotune) > topology default — one worker thread
+        # per round-robin device (per-device copies overlap each other),
+        # several streams on one accelerator (concurrent device_puts are
+        # the only way past a serialized tunnel link), two on the CPU
+        # backend (keeps the async machinery exercised in tests without
+        # thrashing one memory bus)
         if feed_streams <= 0:
-            feed_streams = int(
-                os.environ.get("TRIVY_TPU_FEED_STREAMS", "0") or 0
-            )
+            feed_streams = tuning.feed_streams
         if feed_streams <= 0:
             if rr_devices is not None:
                 feed_streams = len(rr_devices)
@@ -449,20 +469,26 @@ class TpuSecretScanner:
                 feed_streams = SINGLE_DEVICE_STREAMS
         self.feed_streams = max(1, feed_streams)
         if inflight <= 0:
-            inflight = int(
-                os.environ.get("TRIVY_TPU_FEED_INFLIGHT", "0") or 0
-            )
+            inflight = tuning.inflight
         self.inflight = max(1, inflight or FEED_INFLIGHT)
+        # arena override (0 = the derived queue+windows+margin bound in
+        # _ScanRun); clamped there to keep at least a double-buffer cycling
+        self.arena_slabs = max(0, arena_slabs or tuning.arena_slabs)
         # dispatch-shape bucket ladder: every shape compiles exactly once
         # (variable trailing-batch shapes would recompile per distinct size).
-        # The ladder stops at B/4: each extra rung costs a full Mosaic
-        # compile of every kernel (~minutes through a remote-compile
-        # tunnel), while padding a short trailing batch up to B/4 rows
-        # costs microseconds of device time
+        # The default ladder stops at B/4 (3 rungs): each extra rung costs
+        # a full Mosaic compile of every kernel (~minutes through a
+        # remote-compile tunnel), while padding a short trailing batch up
+        # to the smallest rung costs microseconds of device time. The
+        # depth is a tuning knob (--secret-bucket-rungs) because the
+        # tradeoff flips on corpora dominated by tiny trailing batches.
+        rungs = max(1, bucket_rungs or tuning.bucket_rungs or 3)
+        self.bucket_rungs = rungs
+        min_bucket = max(
+            8, row_multiple, self.batch_size // (1 << (rungs - 1))
+        )
         buckets = [self.batch_size]
-        while (
-            buckets[-1] // 2 >= max(8, row_multiple, self.batch_size // 4)
-        ):
+        while buckets[-1] // 2 >= min_bucket:
             buckets.append(buckets[-1] // 2)
         self._buckets = sorted(buckets)
 
@@ -617,6 +643,27 @@ class TpuSecretScanner:
         """Single-file convenience (still device-prefiltered)."""
         return next(iter(self.scan_files([(path, data)])))
 
+    def tuning_snapshot(self) -> dict:
+        """The EFFECTIVE knob set this scanner runs with — post-resolution
+        values, per-knob provenance, and (after a scan) the final values
+        the online controller left behind. Embedded in BENCH rep details,
+        ``--metrics-out``, and heartbeat lines so differently-tuned rounds
+        stay comparable and ``--check-regression`` can annotate knob drift
+        alongside a throughput change."""
+        doc = {
+            "feed_streams": self.feed_streams,
+            "inflight": self.inflight,
+            "arena_slabs": self.arena_slabs,  # 0 = derived per scan
+            "bucket_ladder": list(self._buckets),
+            "controller": bool(self.tuning.controller),
+            "topology": self.tuning.topology,
+            "source": dict(self.tuning.source),
+        }
+        last = getattr(self, "_last_tuning", None)
+        if last:
+            doc["effective"] = dict(last)
+        return doc
+
     def _note_degraded(self, ctx, err: BaseException) -> None:
         logger.warning(
             "device pipeline failed (%s); completing the scan on the exact "
@@ -763,13 +810,40 @@ class _ScanRun:
         self.error: BaseException | None = None
         self.degraded = False
         self.stop = threading.Event()
+        self.feed_done = threading.Event()  # input exhausted (or failed)
         streams = sc.feed_streams
-        self.in_q: queue.Queue = queue.Queue(maxsize=FEED_QUEUE_DEPTH)
-        self.arena = ChunkArena(
-            FEED_QUEUE_DEPTH + streams * sc.inflight + ARENA_MARGIN,
-            sc.batch_size,
-            sc.chunk_len,
+        # online tuning (trivy_tpu/tuning.py): the controller adapts the
+        # ACTIVE stream count, the per-stream in-flight window, and the
+        # arena size mid-scan. Controller-off scans allocate nothing extra
+        # — exactly `streams` worker threads, the derived arena bound, no
+        # controller thread or decision buffers (the zero-cost-when-off
+        # bar bench --smoke enforces)
+        self._controller_on = (
+            bool(sc.tuning.controller) and sc.tuning.tuning_interval > 0
         )
+        self.controller = None
+        if self._controller_on:
+            from trivy_tpu.tuning import inflight_limit, stream_limit
+
+            n_alloc = stream_limit(streams)
+            self._max_inflight = inflight_limit(sc.inflight)
+        else:
+            n_alloc = streams
+            self._max_inflight = sc.inflight
+        self.active_streams = streams
+        self.inflight = sc.inflight  # run-level window; controller-mutable
+        self.in_q: queue.Queue = queue.Queue(maxsize=FEED_QUEUE_DEPTH)
+        slabs = sc.arena_slabs or (
+            FEED_QUEUE_DEPTH + streams * sc.inflight + ARENA_MARGIN
+        )
+        # a 1-slab arena cannot double-buffer: the feeder would block on
+        # the single slab a worker still holds — keep a cycling pair
+        slabs = max(2, slabs)
+        self._max_arena_slabs = max(
+            slabs, FEED_QUEUE_DEPTH + n_alloc * self._max_inflight
+            + ARENA_MARGIN,
+        )
+        self.arena = ChunkArena(slabs, sc.batch_size, sc.chunk_len)
         self.pool = ThreadPoolExecutor(max_workers=sc.confirm_workers)
         # backpressure: bounds queued+running confirms so a slow confirm
         # pool cannot accumulate unbounded _FileState.data on a large
@@ -779,14 +853,14 @@ class _ScanRun:
         # window depths and the confirm queue depth, updated per batch /
         # per confirm — cheap enough to keep on untraced scans, read only
         # by an attached sampler's probe
-        self._stream_inflight = [0] * streams
+        self._stream_inflight = [0] * n_alloc
         self._confirm_inflight = 0
         self.workers = [
             threading.Thread(
                 target=self._worker, args=(i,), daemon=True,
                 name=f"secret-xfer-{i}",
             )
-            for i in range(streams)
+            for i in range(n_alloc)
         ]
         self.feeder = threading.Thread(
             target=self._feed_guarded, daemon=True, name="secret-feeder"
@@ -797,6 +871,54 @@ class _ScanRun:
         for w in self.workers:
             w.start()
         self.feeder.start()
+        if self._controller_on:
+            from trivy_tpu.tuning import TuningController
+
+            self.controller = TuningController(
+                self, ctx=self.ctx,
+                interval=self.sc.tuning.tuning_interval,
+            ).start()
+
+    # -- online-tuning adapter (trivy_tpu.tuning.TuningController) ----------
+
+    def knobs(self) -> dict:
+        return {
+            "feed_streams": self.active_streams,
+            "inflight": self.inflight,
+            "arena_slabs": self.arena.n_slabs,
+        }
+
+    def limits(self) -> dict:
+        return {
+            "max_streams": len(self.workers),
+            "max_inflight": self._max_inflight,
+            "max_arena_slabs": self._max_arena_slabs,
+        }
+
+    def raw_gauges(self) -> dict:
+        s = self.sc.stats.snapshot()
+        busy = self.sc._staged.busy.busy_seconds()
+        return {
+            "queue_depth": float(self.in_q.qsize()),
+            "arena_free": float(self.arena.free_slabs),
+            "bytes_uploaded_total": float(s["bytes_uploaded"]),
+            "batch_splits_total": float(s["batch_splits"]),
+            # mean across dispatch targets: the controller reasons about
+            # "the device side" as one saturation fraction
+            "busy_seconds_total": sum(busy) / max(1, len(busy)),
+        }
+
+    def set_streams(self, n: int) -> None:
+        # growth wakes parked workers (they poll the active count);
+        # shrink parks the highest-numbered streams after they drain
+        # their in-flight windows
+        self.active_streams = max(1, min(len(self.workers), int(n)))
+
+    def set_inflight(self, n: int) -> None:
+        self.inflight = max(1, min(self._max_inflight, int(n)))
+
+    def grow_arena(self, k: int) -> int:
+        return self.arena.grow(int(k), self._max_arena_slabs)
 
     def _telemetry_probe(self) -> dict[str, float]:
         """In-flight pipeline state for the telemetry sampler: arena
@@ -814,6 +936,8 @@ class _ScanRun:
             "secret.bytes_uploaded_total": float(
                 sc.stats.snapshot()["bytes_uploaded"]
             ),
+            "secret.active_streams": float(self.active_streams),
+            "secret.inflight_window": float(self.inflight),
         }
         for i, n in enumerate(self._stream_inflight):
             vals[f"secret.stream{i}.inflight"] = float(n)
@@ -821,6 +945,10 @@ class _ScanRun:
         return vals
 
     def close(self) -> None:
+        # the controller stops FIRST: it mutates active_streams/inflight/
+        # arena, and its final doc() must freeze before the snapshot below
+        if self.controller is not None:
+            self.controller.stop()
         self.ctx.remove_probe(self._telemetry_probe)
         self.stop.set()
         self.feeder.join(timeout=10.0)
@@ -843,6 +971,27 @@ class _ScanRun:
             "arena_free": self.arena.free_slabs,
             "arena_acquires": self.arena.acquires,
             "streams": len(self.workers),
+        }
+        # effective-knob record: what this scan actually ran with at the
+        # end (controller-adapted or static) — tuning_snapshot() surfaces
+        # it into bench reps, --metrics-out, and heartbeat lines
+        ctl_summary = None
+        if self.controller is not None:
+            d = self.controller.doc()
+            # summary only: the full decision log rides the ctx exports
+            # (--trace-out instants, --metrics-out tuning block); this
+            # snapshot goes into compact bench rep details
+            ctl_summary = {
+                "ticks": d.get("ticks", 0),
+                "decisions": d.get("decisions", 0),
+                "initial": d.get("initial"),
+                "final": d.get("final"),
+            }
+        self.sc._last_tuning = {
+            "feed_streams": self.active_streams,
+            "inflight": self.inflight,
+            "arena_slabs": self.arena.n_slabs,
+            "controller": ctl_summary,
         }
 
     # -- shared control -----------------------------------------------------
@@ -1318,14 +1467,34 @@ class _ScanRun:
         with obs.activate(ctx):
             try:
                 while True:
+                    if wid >= self.active_streams:
+                        # parked by the online controller: drain this
+                        # stream's in-flight window, then idle until
+                        # unparked, shutdown, or end of input — a parked
+                        # stream takes no new work, which is exactly how
+                        # "shrink streams" reduces link concurrency
+                        while pending and not self._aborted():
+                            fetch_oldest()
+                            self._stream_inflight[wid] = len(pending)
+                        if self._aborted() or self.feed_done.is_set():
+                            break
+                        self.stop.wait(0.1)
+                        continue
                     with ctx.span("secret.feed_wait"):
                         item = self._get_work()
-                    if item is None or item is _ABORT:
+                    if item is _ABORT:
+                        break
+                    if item is None:
+                        # end-of-input sentinel: re-post it so the next
+                        # active worker sees it too (one sentinel cascades
+                        # through however many streams are active; parked
+                        # workers exit on feed_done instead)
+                        self._put_sentinel()
                         break
                     slab_id, batch, meta = item
                     dispatch_batch(batch, meta, slab_id, 0)
                     self._stream_inflight[wid] = len(pending)
-                    while len(pending) >= sc.inflight:
+                    while len(pending) >= self.inflight:
                         fetch_oldest()
                         self._stream_inflight[wid] = len(pending)
                 while pending and not self._aborted():
@@ -1683,5 +1852,9 @@ class _ScanRun:
                 if feed_ok:
                     self.total = total
                 self.cond.notify_all()
-            for _ in range(len(self.workers)):
-                self._put_sentinel()
+            # end-of-input: parked workers exit on feed_done; ONE sentinel
+            # cascades through the active workers (each re-posts it before
+            # exiting), so the count stays right however many streams the
+            # online controller parked or woke mid-scan
+            self.feed_done.set()
+            self._put_sentinel()
