@@ -890,6 +890,215 @@ def chaos() -> int:
     return 0
 
 
+# saturation-rep shape: enough clients to oversubscribe the 2-scan budget
+# ~4x so the admission queue and shed path both carry real traffic, small
+# per-scan delay so the whole rep stays a few seconds
+SATURATION_CLIENTS = 8
+SATURATION_SCANS_PER_CLIENT = 4
+SATURATION_MAX_CONCURRENT = 2
+SATURATION_SCAN_DELAY_S = 0.02
+
+
+def bench_saturation() -> dict:
+    """``saturation`` rep: N concurrent mixed-tenant clients against one
+    admission-controlled in-process server (README "Multi-tenant
+    serving"), in two phases:
+
+    1. **Measured phase** — every client pumps its scans through the
+       async job API (submit + fast result polling), so throughput and
+       p50/p95 latency reflect the admission queue + worker drain, not
+       randomized client backoff jitter (which made these numbers too
+       noisy to ride ``--check-regression``'s 15% gate).
+    2. **Shed-proof phase** — with the budget deliberately occupied, a
+       bare client must observe a 503/429 carrying ``Retry-After``, and
+       a compliant retrying client must turn that shed into a completed
+       scan. Failures here are RuntimeErrors (the gate), as is a leaked
+       admission worker after the drain.
+
+    Reports the Jain fairness index across the equal-weight tenants'
+    throughputs and the shed rate alongside the latency numbers."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from trivy_tpu import obs
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.rpc.admission import resolve_admission
+    from trivy_tpu.rpc.client import RemoteDriver
+    from trivy_tpu.rpc.server import drain_and_shutdown, start_server
+    from trivy_tpu.scanner import ScanOptions
+
+    cfg = resolve_admission({
+        "max_concurrent_scans": SATURATION_MAX_CONCURRENT,
+        "tenants": ["a:sat-tok-a", "b:sat-tok-b"],
+    })
+    httpd, port = start_server(cache=new_cache("memory", None), admission=cfg)
+    base = f"http://127.0.0.1:{port}"
+    service = httpd.service
+    inner = service.driver.scan
+
+    def slow_scan(*a, **kw):  # give the budget something to contend over
+        time.sleep(SATURATION_SCAN_DELAY_S)
+        return inner(*a, **kw)
+
+    service.driver.scan = slow_scan
+    # untimed warmup through BOTH serve paths: first-touch costs (lazy
+    # imports on the scan/submit/result routes, first worker dispatch)
+    # must not land in the measured numbers or skew one tenant's rate
+    for tok in ("sat-tok-a", "sat-tok-b"):
+        w = RemoteDriver(base, token=tok)
+        w.scan("warmup", "w", [], ScanOptions(scanners=["vuln"]))
+        sub = w.submit("warmup", "w2", [], ScanOptions(scanners=["vuln"]))
+        w.wait_result(sub["JobID"], timeout=30, poll=0.02)
+    lock = threading.Lock()
+    lat_ms: dict = {"a": [], "b": []}
+    finish_at: dict = {"a": 0.0, "b": 0.0}
+    errors: list = []
+    t0 = time.perf_counter()
+
+    def client(i: int) -> None:
+        tenant = "a" if i % 2 == 0 else "b"
+        d = RemoteDriver(base, token=f"sat-tok-{tenant}")
+        for j in range(SATURATION_SCANS_PER_CLIENT):
+            s = time.perf_counter()
+            try:
+                sub = d.submit("sat", f"c{i}-{j}", [],
+                               ScanOptions(scanners=["vuln"]))
+                deadline = time.monotonic() + 60
+                while True:  # fast fixed-cadence poll: latency measures
+                    doc = d.fetch_result(sub["JobID"])  # the QUEUE, not
+                    if doc.get("Status") == "done":     # backoff jitter
+                        break
+                    if doc.get("Status") in ("failed", "expired", "rejected"):
+                        raise RuntimeError(f"job {doc.get('Status')}")
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("job poll timeout")
+                    time.sleep(0.02)
+            except Exception as e:
+                with lock:
+                    errors.append(f"client {i} scan {j}: {e}")
+                return
+            e = time.perf_counter()
+            with lock:
+                lat_ms[tenant].append((e - s) * 1e3)
+                finish_at[tenant] = max(finish_at[tenant], e - t0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(SATURATION_CLIENTS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    elapsed = time.perf_counter() - t0
+
+    # phase 2: honest-shedding proof. Occupy the whole budget with slow
+    # sync scans, then (a) a bare request must see the shed status + a
+    # Retry-After header, and (b) a compliant retrying client must
+    # complete anyway
+    shed_seen: dict = {}
+    occupiers = [
+        threading.Thread(
+            target=lambda: RemoteDriver(base, token="sat-tok-a").scan(
+                "sat", "occupy", [], ScanOptions(scanners=["vuln"])
+            )
+        )
+        for _ in range(SATURATION_MAX_CONCURRENT)
+    ]
+    service.driver.scan = lambda *a, **kw: (time.sleep(0.5), inner(*a, **kw))[1]
+    for th in occupiers:
+        th.start()
+    time.sleep(0.15)  # the occupiers now hold the budget
+    probe = urllib.request.Request(
+        base + "/twirp/trivy.scanner.v1.Scanner/Scan", data=b"{}",
+        headers={"Content-Type": "application/json",
+                 "Trivy-Token": "sat-tok-b"},
+    )
+    try:
+        urllib.request.urlopen(probe, timeout=5)
+        shed_seen["status"] = 200  # budget freed too fast — not a failure
+    except urllib.error.HTTPError as e:
+        shed_seen["status"] = e.code
+        shed_seen["retry_after"] = e.headers.get("Retry-After")
+    retrier = RemoteDriver(base, token="sat-tok-b")
+    retried_ok = True
+    try:
+        retrier.scan("sat", "retry-proof", [], ScanOptions(scanners=["vuln"]))
+    except Exception as e:
+        retried_ok = False
+        errors.append(f"retry-proof: {e}")
+    for th in occupiers:
+        th.join(timeout=30)
+
+    shed_rows = service.admission.shed.collect()
+    sheds = int(sum(shed_rows.values()))
+    admitted = int(sum(service.admission.admitted.collect().values()))
+    drain_and_shutdown(httpd, timeout=10)
+    httpd.server_close()
+    time.sleep(0.1)
+    leaked = [th.name for th in threading.enumerate()
+              if th.name.startswith("admission-worker")]
+    if leaked:
+        raise RuntimeError(f"saturation rep leaked admission workers: "
+                           f"{leaked}")
+    if errors:
+        raise RuntimeError(f"saturation rep clients failed: {errors[:3]}")
+    if shed_seen.get("status") not in (200, 429, 503):
+        # 200 = the budget freed before the probe (not a failure); a shed
+        # must be 429/503 — anything else (a 500 from a regressed gate)
+        # would otherwise slip past the Retry-After check unproven
+        raise RuntimeError(
+            f"saturation probe expected a shed (429/503) or 200, got "
+            f"{shed_seen.get('status')}"
+        )
+    if shed_seen.get("status") in (429, 503) and not shed_seen.get(
+        "retry_after"
+    ):
+        raise RuntimeError(
+            f"shed response {shed_seen['status']} carried no Retry-After "
+            f"— shedding must tell clients when to come back"
+        )
+    if not retried_ok:
+        raise RuntimeError(
+            "a compliant retrying client failed to complete through a "
+            "saturated budget — Retry-After was not honest"
+        )
+    total = sum(len(v) for v in lat_ms.values())
+    want = SATURATION_CLIENTS * SATURATION_SCANS_PER_CLIENT
+    if total != want:
+        raise RuntimeError(
+            f"saturation rep completed {total}/{want} scans"
+        )
+    all_lat = sorted(lat_ms["a"] + lat_ms["b"])
+    rates = [
+        len(lat_ms[t]) / max(1e-6, finish_at[t]) for t in ("a", "b")
+    ]
+    jain = sum(rates) ** 2 / (len(rates) * sum(r * r for r in rates))
+    return {
+        "metric": "saturation_admission_throughput",
+        "value": round(total / elapsed, 2),
+        "unit": "scans/s",
+        "detail": {
+            "clients": SATURATION_CLIENTS,
+            "scans_per_client": SATURATION_SCANS_PER_CLIENT,
+            "max_concurrent": SATURATION_MAX_CONCURRENT,
+            "p50_ms": round(obs.percentile(all_lat, 50), 1),
+            "p95_ms": round(obs.percentile(all_lat, 95), 1),
+            "jain_fairness": round(jain, 4),
+            "shed_rate": round(sheds / max(1, sheds + admitted), 4),
+            "sheds": sheds,
+            "admitted": admitted,
+            "shed_proof": {
+                "status": shed_seen.get("status"),
+                "retry_after": shed_seen.get("retry_after"),
+                "retried_ok": retried_ok,
+            },
+            "tenant_rates_per_s": {
+                "a": round(rates[0], 2), "b": round(rates[1], 2),
+            },
+        },
+    }
+
+
 # stages every smoke rep must record: a refactor that silently drops
 # instrumentation from the secret feed path (the spans the stall verdict
 # and the perf rounds depend on) fails the smoke loudly instead of
@@ -1071,6 +1280,42 @@ def _smoke_controller() -> str | None:
     return None
 
 
+def _smoke_admission_off() -> str | None:
+    """Zero-cost-when-off gate for admission control (same discipline as
+    the sampler and the tuning controller): a server started WITHOUT
+    admission must allocate no controller, no queue worker threads, no
+    per-tenant state, and render no admission metric — byte-identical
+    serving behavior to a pre-admission server. Returns an error string
+    on violation."""
+    import threading
+    import urllib.request
+
+    from trivy_tpu.cache import new_cache
+    from trivy_tpu.rpc.server import start_server
+
+    httpd, port = start_server(cache=new_cache("memory", None))
+    base = f"http://127.0.0.1:{port}"
+    try:
+        if httpd.service.admission is not None:
+            return "admission-off server allocated an AdmissionController"
+        workers = [t.name for t in threading.enumerate()
+                   if t.name.startswith("admission-worker")]
+        if workers:
+            return (f"admission-off server allocated queue worker "
+                    f"thread(s): {workers}")
+        text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        if "trivy_tpu_admission" in text:
+            return "admission-off /metrics renders admission instruments"
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz").read()
+        )
+        if "Admission" in health:
+            return "admission-off /healthz grew an Admission block"
+    finally:
+        httpd.shutdown()
+    return None
+
+
 def _smoke_client_mode() -> tuple[list[str], dict, str]:
     """Client-mode traced rep against an in-process server: returns the
     server-side stage names that joined the client trace, the merged
@@ -1242,6 +1487,10 @@ def smoke(trace_out=None, metrics_out=None) -> int:
     if ctl_err:
         print(f"FATAL: {ctl_err}", file=sys.stderr)
         return 1
+    adm_err = _smoke_admission_off()
+    if adm_err:
+        print(f"FATAL: {adm_err}", file=sys.stderr)
+        return 1
     server_stages, client_profile, client_trace_id = _smoke_client_mode()
     if not server_stages:
         print(
@@ -1268,6 +1517,7 @@ def smoke(trace_out=None, metrics_out=None) -> int:
                 "counter_tracks": ts.names(),
                 "sampler_overhead_pct": round(overhead_pct, 2),
                 "tuning_controller": "ok",  # schema + zero-cost gates held
+                "admission_off": "ok",  # zero-cost-when-off gate held
                 "client_mode": {
                     "trace_id": client_trace_id,
                     "server_stages": server_stages,
@@ -1384,7 +1634,10 @@ REGRESSION_THRESHOLD = 0.15
 
 # metrics where UP is the regression direction (link cost per scanned
 # byte): a >threshold RISE fails exactly like a throughput drop
-LOWER_IS_BETTER = {"device_bytes_uploaded_per_scanned_byte"}
+LOWER_IS_BETTER = {
+    "device_bytes_uploaded_per_scanned_byte",
+    "saturation_p95_ms",
+}
 
 # utilization telemetry (sampled during the traced rep): a drop here fails
 # the gate ONLY when the headline throughput also fell — with throughput
@@ -1445,6 +1698,15 @@ def _metric_values(doc: dict) -> dict:
             ratio, (int, float)
         ):
             out["device_bytes_uploaded_per_scanned_byte"] = float(ratio)
+        if m.get("metric") == "saturation_admission_throughput":
+            # guard fairness and tail latency alongside the scans/s value:
+            # a fairness collapse or a p95 blow-up is a serving regression
+            # even when aggregate throughput holds
+            det = m.get("detail") or {}
+            if isinstance(det.get("jain_fairness"), (int, float)):
+                out["saturation_jain_fairness"] = float(det["jain_fairness"])
+            if isinstance(det.get("p95_ms"), (int, float)):
+                out["saturation_p95_ms"] = float(det["p95_ms"])
     return out
 
 
@@ -1622,6 +1884,7 @@ def main():
         ("cached_image_layer_rate", bench_image_layers),
         ("streaming_scan_throughput", _run_streaming_child),
         ("chaos_recovery", lambda: bench_chaos(rng)),
+        ("saturation_admission_throughput", bench_saturation),
     ):
         try:
             extra_metrics.append(fn())

@@ -1,0 +1,1226 @@
+"""Overload-safe multi-tenant serving (trivy_tpu/rpc/admission.py):
+capacity-budgeted admission, per-tenant quotas + weighted fair dequeue,
+the async job API, honest shedding with Retry-After, drain behavior, and
+the deterministic chaos legs through the ``admission.*`` fault sites."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trivy_tpu import faults
+from trivy_tpu.cache import new_cache
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.rpc.admission import (
+    AdmissionController,
+    parse_tenants,
+    resolve_admission,
+    validate_count,
+    validate_seconds,
+)
+from trivy_tpu.rpc.client import (
+    RemoteDriver,
+    RPCError,
+    get_progress,
+    get_result,
+)
+from trivy_tpu.rpc.server import ScanServer, drain_and_shutdown, start_server
+from trivy_tpu.scanner import ScanOptions
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def _controller(opts, server=None):
+    """An AdmissionController with NO worker threads (start() not called)
+    so queue mechanics are deterministic under test."""
+    cfg = resolve_admission(opts)
+    if server is None:
+        server = ScanServer(new_cache("memory", None))
+    return AdmissionController(server, cfg, registry=server.metrics.registry)
+
+
+def _admitted_server(cache=None, **opts):
+    """In-process server with admission enabled."""
+    opts.setdefault("max_concurrent_scans", 2)
+    cfg = resolve_admission(opts)
+    httpd, port = start_server(
+        cache=cache or new_cache("memory", None), admission=cfg
+    )
+    return httpd, f"http://127.0.0.1:{port}"
+
+
+def _slow_scan(httpd, delay=0.2):
+    """Wrap the service driver so every server-side scan takes ``delay``
+    seconds — the saturation lever for concurrency/shed tests."""
+    service = httpd.service
+    inner = service.driver.scan
+
+    def slow(*a, **kw):
+        time.sleep(delay)
+        return inner(*a, **kw)
+
+    service.driver.scan = slow
+    return service
+
+
+# -- config resolution --------------------------------------------------------
+
+
+class TestConfig:
+    def test_admission_off_by_default(self):
+        cfg = resolve_admission({}, env={})
+        assert not cfg.enabled
+
+    def test_env_enables_and_validates_loudly(self):
+        cfg = resolve_admission({}, env={"TRIVY_TPU_MAX_CONCURRENT_SCANS": "3"})
+        assert cfg.enabled and cfg.max_concurrent == 3
+        for env in (
+            {"TRIVY_TPU_MAX_CONCURRENT_SCANS": "lots"},
+            {"TRIVY_TPU_MAX_CONCURRENT_SCANS": "-1"},
+            {"TRIVY_TPU_MAX_CONCURRENT_SCANS": "2",
+             "TRIVY_TPU_ADMISSION_QUEUE_DEPTH": "nan-ish"},
+            {"TRIVY_TPU_MAX_CONCURRENT_SCANS": "2",
+             "TRIVY_TPU_JOB_DEADLINE": "inf"},
+        ):
+            with pytest.raises(ValueError):
+                resolve_admission({}, env=env)
+
+    def test_garbage_env_kills_server_boot(self, monkeypatch):
+        # the satellite contract: bad limits fail at ScanServer
+        # construction, not on the Nth request
+        monkeypatch.setenv("TRIVY_TPU_MAX_CONCURRENT_SCANS", "banana")
+        with pytest.raises(ValueError, match="MAX_CONCURRENT_SCANS"):
+            ScanServer(new_cache("memory", None))
+
+    def test_max_request_bytes_env_validated_at_boot(self, monkeypatch):
+        monkeypatch.setenv("TRIVY_TPU_MAX_REQUEST_BYTES", "not-bytes")
+        with pytest.raises(ValueError, match="MAX_REQUEST_BYTES"):
+            ScanServer(new_cache("memory", None))
+        monkeypatch.setenv("TRIVY_TPU_MAX_REQUEST_BYTES", "0")
+        with pytest.raises(ValueError, match="MAX_REQUEST_BYTES"):
+            ScanServer(new_cache("memory", None))
+        monkeypatch.setenv("TRIVY_TPU_MAX_REQUEST_BYTES", "1048576")
+        srv = ScanServer(new_cache("memory", None))
+        assert srv.max_request_bytes == 1 << 20
+
+    def test_quota_knobs_without_budget_refused(self):
+        for orphan in (
+            {"tenants": ["a:t"]},
+            {"admission_queue_depth": 5},
+            {"tenant_max_inflight": 5},
+            {"job_retention": 5},
+            {"job_deadline": 30.0},
+        ):
+            with pytest.raises(ValueError, match="max-concurrent-scans"):
+                resolve_admission(orphan, env={})
+
+    def test_explicit_zero_knobs_honored(self):
+        # 0 is a legal operator choice, not "unset": a zero-depth queue
+        # sheds every submit, zero retention keeps no finished jobs
+        cfg = resolve_admission(
+            {"max_concurrent_scans": 1, "admission_queue_depth": 0,
+             "job_retention": 0},
+            env={},
+        )
+        assert cfg.queue_depth == 0
+        assert cfg.result_keep == 0
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "admission_queue_depth": 0})
+        t = ctl.tenant_for("")
+        code, payload, _ = ctl.submit({}, t, 10)
+        assert code == 503 and "queue-full" in payload["error"]
+
+    def test_budgets_derive_from_hbm_proxy(self):
+        from trivy_tpu.tuning import admission_budgets
+
+        base = admission_budgets(env={})
+        assert base["max_concurrent"] >= 1
+        assert base["queued_bytes"] == 1024 << 20
+        # a smaller HBM budget admits fewer concurrent scans
+        small = admission_budgets(env={"TRIVY_TPU_HBM_BUDGET_MB": "128"})
+        assert small["max_concurrent"] <= base["max_concurrent"]
+        assert small["queued_bytes"] == 128 << 20
+        with pytest.raises(ValueError, match="HBM_BUDGET"):
+            admission_budgets(env={"TRIVY_TPU_HBM_BUDGET_MB": "zero?"})
+
+    def test_validators(self):
+        assert validate_count("4", "x") == 4
+        assert validate_seconds("1.5", "x") == 1.5
+        for bad in ("x", "-1", None):
+            with pytest.raises(ValueError):
+                validate_count(bad, "x")
+        for bad in ("nan", "inf", "-2", "x"):
+            with pytest.raises(ValueError):
+                validate_seconds(bad, "x")
+
+
+class TestTenants:
+    def test_parse_grammar(self):
+        t = parse_tenants(["alice:tok-a:2.5", "bob:tok-b"])
+        assert t["alice"].weight == 2.5 and t["bob"].weight == 1.0
+        assert t["alice"].token == "tok-a"
+        assert t["alice"].max_inflight == 0  # 0 = config-wide default
+
+    def test_parse_per_tenant_quota_fields(self):
+        t = parse_tenants(["a:ta:2:3:64", "b:tb::5", "c:tc:1.5"])
+        assert t["a"].weight == 2 and t["a"].max_inflight == 3
+        assert t["a"].max_queued_bytes == 64 << 20
+        assert t["b"].weight == 1.0  # empty weight field falls back
+        assert t["b"].max_inflight == 5 and t["b"].max_queued_bytes == 0
+        assert t["c"].max_inflight == 0 and t["c"].max_queued_bytes == 0
+
+    def test_parse_rejects_garbage(self):
+        for bad in (["alice"], ["a:"], [":t"], ["a:t:heavy"], ["a:t:0"],
+                    ["a:t:-1"], ["a:t:nan"], ["a:t:1:extra"],
+                    ["a:t:1:2:-3"], ["a:t:1:2:3:4"]):
+            with pytest.raises(ValueError):
+                parse_tenants(bad)
+        with pytest.raises(ValueError, match="duplicate tenant name"):
+            parse_tenants(["a:t1", "a:t2"])
+        with pytest.raises(ValueError, match="duplicate token"):
+            parse_tenants(["a:t", "b:t"])
+
+    def test_token_maps_to_tenant_default_fallback(self):
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "tenants": ["a:ta", "b:tb"]})
+        assert ctl.tenant_for("ta").name == "a"
+        assert ctl.tenant_for("tb").name == "b"
+        assert ctl.tenant_for("nope").name == "default"
+        assert ctl.tenant_for("").name == "default"
+
+
+# -- queue mechanics (no workers, no HTTP) ------------------------------------
+
+
+def _drain_order(ctl, n=100):
+    order = []
+    with ctl._cond:
+        while len(order) < n:
+            j = ctl._pop_next_locked()
+            if j is None:
+                break
+            order.append(j)
+    return order
+
+
+class TestQueue:
+    def test_fair_dequeue_interleaves_tenants(self):
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "tenants": ["a:ta", "b:tb"]})
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        # tenant a floods first; b arrives later with the same job size —
+        # the dequeue must interleave, not drain a's burst first
+        for i in range(4):
+            assert ctl.submit({"Target": f"a{i}"}, ta, 100)[0] == 202
+        for i in range(4):
+            assert ctl.submit({"Target": f"b{i}"}, tb, 100)[0] == 202
+        order = [j.tenant for j in _drain_order(ctl)]
+        assert order == ["a", "b", "a", "b", "a", "b", "a", "b"]
+
+    def test_weighted_dequeue_respects_weights(self):
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "tenants": ["a:ta:2", "b:tb:1"]})
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        for i in range(8):
+            ctl.submit({"Target": f"a{i}"}, ta, 100)
+            ctl.submit({"Target": f"b{i}"}, tb, 100)
+        first9 = [j.tenant for j in _drain_order(ctl)][:9]
+        # weight 2 tenant gets ~2x the service in any window
+        assert first9.count("a") == 6 and first9.count("b") == 3
+
+    def test_fractional_weights_drain_without_stalling(self):
+        # a sub-1 weight must slow a tenant RELATIVE to others, never
+        # stall the queue when the budget is idle (the quantum scales by
+        # the smallest active weight, so every pass affords a head job)
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "tenants": ["a:ta:0.05"]})
+        ta = ctl.cfg.tenants["a"]
+        for i in range(5):
+            ctl.submit({"Target": f"a{i}"}, ta, 100)
+        assert len(_drain_order(ctl)) == 5
+        # and relative shares still follow the weights
+        ctl2 = _controller({"max_concurrent_scans": 1,
+                            "tenants": ["a:ta:0.5", "b:tb:0.25"]})
+        a2, b2 = ctl2.cfg.tenants["a"], ctl2.cfg.tenants["b"]
+        for i in range(8):
+            ctl2.submit({"Target": f"a{i}"}, a2, 100)
+            ctl2.submit({"Target": f"b{i}"}, b2, 100)
+        first6 = [j.tenant for j in _drain_order(ctl2)][:6]
+        assert first6.count("a") == 4 and first6.count("b") == 2
+
+    def test_byte_costed_dequeue_sweep_cannot_starve(self):
+        # tenant a queues few huge jobs (a registry sweep), tenant b many
+        # small interactive ones: byte-costed DRR must keep serving b
+        # between a's jobs
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "tenants": ["a:ta", "b:tb"]})
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        for i in range(3):
+            ctl.submit({"Target": f"sweep{i}"}, ta, 10 << 20)
+        for i in range(30):
+            ctl.submit({"Target": f"i{i}"}, tb, 4096)
+        order = [j.tenant for j in _drain_order(ctl)]
+        # every sweep job is separated by a run of interactive jobs
+        first_sweep = order.index("a")
+        second_sweep = order.index("a", first_sweep + 1)
+        assert second_sweep - first_sweep > 1, order
+
+    def test_queue_depth_shed_503_with_retry_after(self):
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "admission_queue_depth": 2})
+        t = ctl.tenant_for("")
+        assert [ctl.submit({}, t, 10)[0] for _ in range(2)] == [202, 202]
+        code, payload, headers = ctl.submit({}, t, 10)
+        assert code == 503
+        assert "queue-full" in payload["error"]
+        assert int(headers["Retry-After"]) >= 1
+        assert ctl.shed.value(tenant="default", reason="queue-full") == 1
+
+    def test_queued_bytes_budget_shed(self):
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "admission_queued_mb": 1})
+        t = ctl.tenant_for("")
+        assert ctl.submit({}, t, 900 << 10)[0] == 202
+        code, payload, _ = ctl.submit({}, t, 900 << 10)
+        assert code == 503 and "queued-bytes" in payload["error"]
+
+    def test_tenant_queued_bytes_quota_429(self):
+        ctl = _controller({
+            "max_concurrent_scans": 1, "tenant_queued_mb": 1,
+            "tenants": ["a:ta", "b:tb"],
+        })
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        assert ctl.submit({}, ta, 900 << 10)[0] == 202
+        code, payload, headers = ctl.submit({}, ta, 900 << 10)
+        assert code == 429 and "tenant-bytes" in payload["error"]
+        assert int(headers["Retry-After"]) >= 1
+        # the OTHER tenant is still admitted — 429 is per-tenant
+        assert ctl.submit({}, tb, 900 << 10)[0] == 202
+
+    def test_deadline_expires_queued_job(self):
+        ctl = _controller({"max_concurrent_scans": 1})
+        t = ctl.tenant_for("")
+        _, sub, _ = ctl.submit({}, t, 10, deadline_s=0.05)
+        _, keep, _ = ctl.submit({}, t, 10)
+        time.sleep(0.1)
+        popped = _drain_order(ctl)
+        # the expired job never starts; the fresh one is served
+        assert [j.id for j in popped] == [keep["JobID"]]
+        code, doc, _ = ctl.result(sub["JobID"])
+        assert code == 200 and doc["Status"] == "expired"
+        assert ctl.jobs_c.value(status="expired") == 1
+
+    def test_tenant_inflight_limit_holds_jobs_queued(self):
+        ctl = _controller({
+            "max_concurrent_scans": 4, "tenant_max_inflight": 1,
+            "tenants": ["a:ta"],
+        })
+        ta = ctl.cfg.tenants["a"]
+        ctl.submit({}, ta, 10)
+        ctl.submit({}, ta, 10)
+        with ctl._cond:
+            first = ctl._pop_next_locked()
+            assert first is not None
+            ctl._tenant_inflight["a"] = 1  # simulate it running
+            assert ctl._pop_next_locked() is None  # quota holds #2 back
+            ctl._tenant_inflight["a"] = 0
+            assert ctl._pop_next_locked() is not None
+
+    def test_per_tenant_spec_quota_overrides_config_wide(self):
+        """The optional spec fields (name:token:weight:inflight:mb)
+        override the config-wide per-tenant knobs, 0 falls back."""
+        ctl = _controller({
+            "max_concurrent_scans": 8, "tenant_max_inflight": 1,
+            "tenant_queued_mb": 1,
+            "tenants": ["a:ta:1:3:4", "b:tb"],
+        })
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        assert ctl._tenant_inflight_limit(ta) == 3   # spec override
+        assert ctl._tenant_inflight_limit(tb) == 1   # config-wide
+        assert ctl._tenant_queued_limit(ta) == 4 << 20
+        assert ctl._tenant_queued_limit(tb) == 1 << 20
+        # and the sync gate enforces the override, not the default
+        assert ctl.try_acquire(ta) is None
+        assert ctl.try_acquire(ta) is None
+        assert ctl.try_acquire(ta) is None
+        assert ctl.try_acquire(ta) == "tenant-inflight"
+        assert ctl.try_acquire(tb) is None
+        assert ctl.try_acquire(tb) == "tenant-inflight"
+
+    def test_sync_acquire_concurrency_and_quota(self):
+        ctl = _controller({
+            "max_concurrent_scans": 2, "tenant_max_inflight": 1,
+            "tenants": ["a:ta", "b:tb"],
+        })
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        assert ctl.try_acquire(ta) is None
+        assert ctl.try_acquire(ta) == "tenant-inflight"
+        assert ctl.try_acquire(tb) is None
+        assert ctl.try_acquire(tb) == "concurrency"
+        ctl.release(ta)
+        assert ctl.try_acquire(ta) is None
+
+    def test_retry_after_tracks_drain_rate(self):
+        ctl = _controller({"max_concurrent_scans": 1})
+        assert ctl.retry_after(10) >= 1  # no completions: default floor
+        now = time.monotonic()
+        with ctl._cond:
+            for i in range(20):  # 20 completions over the last ~2s
+                ctl._completions.append(now - 2.0 + i * 0.1)
+        fast = ctl.retry_after(5)
+        slow = ctl.retry_after(100)
+        assert 1 <= fast <= slow <= 120
+
+    def test_breakers_all_open_sheds_early(self):
+        gauge = obs_metrics.REGISTRY.gauge(
+            "trivy_tpu_device_breaker_open",
+            "1 while the per-device dispatch circuit breaker is open",
+            labelnames=("device",),
+        )
+        before = gauge.collect()
+        try:
+            for k in before:
+                gauge.remove(device=k[0])
+            gauge.set(1, device="dX")
+            gauge.set(1, device="dY")
+            ctl = _controller({"max_concurrent_scans": 2})
+            t = ctl.tenant_for("")
+            # an IDLE server still admits one scan: breakers half-open
+            # probe only when a scan dispatches, so shedding everything
+            # on a stale all-open gauge would brick the server forever
+            assert ctl.try_acquire(t) is None
+            # ...but with work already in flight, new work is shed early
+            # rather than queued into the degraded host path
+            code, payload, _ = ctl.submit({}, t, 10)
+            assert code == 503 and "breakers-open" in payload["error"]
+            assert ctl.try_acquire(t) == "breakers-open"
+            ctl.release(t)
+            # one device recovering re-opens admission fully
+            gauge.set(0, device="dX")
+            assert ctl.submit({}, t, 10)[0] == 202
+        finally:
+            for k in gauge.collect():
+                gauge.remove(device=k[0])
+            for key, v in before.items():
+                gauge.set(v, device=key[0])
+
+    def test_gauge_pressure_tightens_shed_point(self):
+        from trivy_tpu.obs import timeseries as obs_timeseries
+
+        reg = obs_metrics.REGISTRY
+        busy = reg.gauge(
+            "trivy_tpu_device_busy_ratio",
+            "Fraction of the last sampling interval the device had "
+            "work in flight",
+            labelnames=("device",),
+        )
+        arena = reg.gauge(
+            "trivy_tpu_arena_free_slabs",
+            "Free slabs in the secret feed's chunk arena",
+        )
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "admission_queue_depth": 8})
+        t = ctl.tenant_for("")
+        obs_timeseries._note_sampler_started()
+        try:
+            busy.set(0.99, device="d0")
+            arena.set(0)
+            # below half depth: pressure alone never sheds
+            for _ in range(4):
+                assert ctl.submit({}, t, 10)[0] == 202
+            # at half depth + saturation: shed before the queue fills
+            code, payload, _ = ctl.submit({}, t, 10)
+            assert code == 503 and "gauge-pressure" in payload["error"]
+            # pressure released: the same submit is admitted again
+            arena.set(3)
+            assert ctl.submit({}, t, 10)[0] == 202
+        finally:
+            busy.remove(device="d0")
+            arena.remove()
+            obs_timeseries._note_sampler_stopped()
+
+    def test_submit_key_is_idempotent(self):
+        # a retried submit (lost 202) with the same SubmitKey returns the
+        # SAME job; a different key (a genuinely new submit) gets a twin
+        ctl = _controller({"max_concurrent_scans": 1})
+        t = ctl.tenant_for("")
+        _, first, _ = ctl.submit({}, t, 10, submit_key="k1")
+        _, replay, _ = ctl.submit({}, t, 10, submit_key="k1")
+        assert replay["JobID"] == first["JobID"]
+        _, fresh, _ = ctl.submit({}, t, 10, submit_key="k2")
+        assert fresh["JobID"] != first["JobID"]
+        assert ctl.queue_depth() == 2  # the replay enqueued nothing
+
+    def test_submit_key_is_tenant_scoped(self):
+        """Regression: the idempotency table is keyed by (tenant, key) —
+        tenant B replaying a key tenant A used must mint its OWN job,
+        never receive (and then be able to poll) A's job id."""
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "tenants": ["a:ta", "b:tb"]})
+        ta, tb = ctl.cfg.tenants["a"], ctl.cfg.tenants["b"]
+        _, a_doc, _ = ctl.submit({}, ta, 10, submit_key="shared")
+        _, b_doc, _ = ctl.submit({}, tb, 10, submit_key="shared")
+        assert b_doc["JobID"] != a_doc["JobID"]
+        assert ctl.queue_depth() == 2
+        # and each tenant's replay still dedups to its own job
+        _, a2, _ = ctl.submit({}, ta, 10, submit_key="shared")
+        assert a2["JobID"] == a_doc["JobID"]
+
+    def test_explicit_zero_byte_budgets_honored(self):
+        cfg = resolve_admission(
+            {"max_concurrent_scans": 1, "admission_queued_mb": 0}, env={}
+        )
+        assert cfg.queued_bytes == 0
+        ctl = _controller({"max_concurrent_scans": 1,
+                           "admission_queued_mb": 0})
+        t = ctl.tenant_for("")
+        code, payload, _ = ctl.submit({}, t, 10)
+        assert code == 503 and "queued-bytes" in payload["error"]
+
+    def test_result_retention_bounded(self):
+        ctl = _controller({"max_concurrent_scans": 1, "job_retention": 2})
+        t = ctl.tenant_for("")
+        ids = []
+        for i in range(4):
+            _, sub, _ = ctl.submit({}, t, 10, deadline_s=0.001)
+            ids.append(sub["JobID"])
+        time.sleep(0.01)
+        with ctl._cond:
+            while ctl._pop_next_locked() is not None:
+                pass
+        # all four expired; only the 2 newest survive retention
+        assert ctl.result(ids[0])[0] == 404
+        assert ctl.result(ids[1])[0] == 404
+        assert ctl.result(ids[2])[0] == 200
+        assert ctl.result(ids[3])[0] == 200
+
+
+# -- stall-verdict / observability -------------------------------------------
+
+
+def test_queue_wait_feeds_stall_verdict():
+    from trivy_tpu import obs
+    from trivy_tpu.obs import stall
+
+    with obs.scan_context(name="t", enabled=True) as ctx:
+        ctx.add("admission.queue_wait", 0.5)
+    assert stall.attribution(ctx)["admission"] == {"queue-bound": 100}
+    assert "queue-bound" in stall.ORDER
+
+
+# -- HTTP integration ---------------------------------------------------------
+
+
+class TestJobAPI:
+    def test_submit_poll_result_roundtrip(self):
+        httpd, base = _admitted_server()
+        try:
+            d = RemoteDriver(base)
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            assert sub["JobID"] == sub["TraceID"]
+            assert sub["QueuePosition"] >= 1
+            resp = d.wait_result(sub["JobID"], timeout=30)
+            assert "Results" in resp
+            # terminal results are retained for re-polling
+            doc = d.fetch_result(sub["JobID"])
+            assert doc["Status"] == "done"
+            assert doc["QueueWaitSeconds"] >= 0
+        finally:
+            httpd.shutdown()
+
+    def test_submit_requires_admission(self):
+        httpd, port = start_server(cache=new_cache("memory", None))
+        base = f"http://127.0.0.1:{port}"
+        try:
+            req = urllib.request.Request(
+                f"{base}/scan/submit", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 404
+        finally:
+            httpd.shutdown()
+
+    def test_bad_deadline_400(self):
+        httpd, base = _admitted_server()
+        try:
+            req = urllib.request.Request(
+                f"{base}/scan/submit",
+                data=json.dumps({"DeadlineSeconds": "-3"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+
+    def test_non_dict_json_body_400_not_dropped(self):
+        """Regression: valid-JSON non-object bodies ([1,2], "x", null)
+        used to TypeError in _handle_submit and drop the connection;
+        the _read_body contract is an HTTP error, always."""
+        httpd, base = _admitted_server()
+        try:
+            for payload in (b"[1, 2]", b'"x"', b"null", b"42"):
+                req = urllib.request.Request(
+                    f"{base}/scan/submit", data=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=5)
+                assert ei.value.code == 400, payload
+                assert "JSON object" in json.loads(ei.value.read())["error"]
+        finally:
+            httpd.shutdown()
+
+    def test_wait_result_tolerates_transient_poll_failure(self):
+        """Regression: one transient poll blip must not abort a job that
+        is still running server-side; a persistent failure still
+        surfaces after a few polls."""
+        d = RemoteDriver("http://127.0.0.1:1")  # never dialed below
+        calls = {"n": 0}
+
+        def flaky(job_id):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RPCError("poll blip")
+            return {"Status": "done", "Result": {"Results": []}}
+
+        d.fetch_result = flaky
+        resp = d.wait_result("j1", timeout=5, poll=0.01)
+        assert resp == {"Results": []} and calls["n"] == 3
+
+        d.fetch_result = lambda job_id: (_ for _ in ()).throw(
+            RPCError("gone")
+        )
+        with pytest.raises(RPCError, match="gone"):
+            d.wait_result("j2", timeout=5, poll=0.01)
+
+    def test_progress_api_is_poll_half_of_job(self):
+        httpd, base = _admitted_server()
+        _slow_scan(httpd, delay=0.4)
+        try:
+            d = RemoteDriver(base)
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            # while the job runs, the progress API answers under its id
+            deadline = time.monotonic() + 10
+            seen = False
+            while time.monotonic() < deadline:
+                try:
+                    snap = get_progress(base, sub["JobID"])
+                    seen = "Ratio" in snap
+                    break
+                except RPCError:
+                    time.sleep(0.02)
+            assert seen, "progress never appeared for the job's trace id"
+            d.wait_result(sub["JobID"], timeout=30)
+        finally:
+            httpd.shutdown()
+
+    def test_expired_job_refuses_to_start(self):
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        _slow_scan(httpd, delay=0.5)
+        try:
+            d = RemoteDriver(base)
+            # the first job occupies the only worker; the second expires
+            # in queue before the worker frees up
+            first = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            doomed = d.submit(
+                "t", "a2", [], ScanOptions(scanners=["vuln"]),
+                deadline_s=0.1,
+            )
+            with pytest.raises(RPCError, match="expired"):
+                d.wait_result(doomed["JobID"], timeout=30)
+            d.wait_result(first["JobID"], timeout=30)
+        finally:
+            httpd.shutdown()
+
+    def test_result_403_before_404_uniform(self):
+        """Regression (satellite): on a token-protected server the token
+        check precedes any id lookup, so unauthenticated probes get a
+        uniform 403 for existing AND unknown ids — no existence oracle."""
+        cfg = resolve_admission({"max_concurrent_scans": 1})
+        httpd, port = start_server(
+            cache=new_cache("memory", None), token="sesame", admission=cfg
+        )
+        base = f"http://127.0.0.1:{port}"
+        try:
+            d = RemoteDriver(base, token="sesame")
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            d.wait_result(sub["JobID"], timeout=30)
+            real, fake = sub["JobID"], "ab" * 16
+            for job_id in (real, fake):
+                with pytest.raises(RPCError, match="HTTP 403"):
+                    get_result(base, job_id)  # no token
+                with pytest.raises(RPCError, match="HTTP 403"):
+                    get_result(base, job_id, token="wrong")
+                with pytest.raises(RPCError, match="HTTP 403"):
+                    get_progress(base, job_id, token="wrong")
+            # authenticated: real id answers, unknown id 404s
+            assert get_result(base, real, token="sesame")["Status"] == "done"
+            with pytest.raises(RPCError, match="HTTP 404"):
+                get_result(base, fake, token="sesame")
+        finally:
+            httpd.shutdown()
+
+    def test_tenants_without_server_token_stay_open(self):
+        """Tenants alone buy fair scheduling, not authentication: a
+        server without --token keeps serving anonymous requests (they
+        share the default tenant) even with a tenant map configured."""
+        httpd, base = _admitted_server(tenants=["a:tok-a"])
+        try:
+            anon = RemoteDriver(base, retries=0)
+            anon.scan("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            adm = httpd.service.admission
+            assert adm.admitted.value(tenant="default") == 1
+            # a tenant token is still mapped for accounting
+            named = RemoteDriver(base, token="tok-a", retries=0)
+            named.scan("t", "a2", [], ScanOptions(scanners=["vuln"]))
+            assert adm.admitted.value(tenant="a") == 1
+        finally:
+            httpd.shutdown()
+
+    def test_malformed_body_answers_http_not_dropped_connection(self):
+        httpd, base = _admitted_server()
+        try:
+            # garbage Content-Length on the admitted sync path
+            req = urllib.request.Request(
+                f"{base}/twirp/trivy.scanner.v1.Scanner/Scan", data=b"{}",
+                headers={"Content-Type": "application/json",
+                         "Content-Length": "banana"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+            # corrupt gzip body on the submit route
+            req = urllib.request.Request(
+                f"{base}/scan/submit", data=b"not-gzip-at-all",
+                headers={"Content-Type": "application/json",
+                         "Content-Encoding": "gzip"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+
+    def test_async_jobs_hold_db_reload_guard(self):
+        """An advisory-DB hot swap must wait for async jobs exactly like
+        sync requests — the reload must not land mid-scan."""
+        from trivy_tpu.rpc.server import DBReloader
+
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        service = _slow_scan(httpd, delay=0.4)
+        reloads: list = []
+
+        class _Reloader(DBReloader):
+            def reload(self):
+                # skip the real DB load; just exercise the in-flight gate
+                with self._cond:
+                    self._updating = True
+                    while self._inflight > 0:
+                        self._cond.wait()
+                    reloads.append(time.monotonic())
+                    self._updating = False
+                    self._cond.notify_all()
+
+        service.reloader = _Reloader(service, "unused", interval=9999)
+        try:
+            d = RemoteDriver(base)
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            time.sleep(0.1)  # the worker is now mid-scan
+            t0 = time.monotonic()
+            service.reloader.reload()  # must block until the job finishes
+            assert reloads and reloads[0] - t0 > 0.15
+            d.wait_result(sub["JobID"], timeout=30)
+        finally:
+            httpd.shutdown()
+
+    def test_tenant_token_authenticates_rpc(self):
+        cfg = resolve_admission({
+            "max_concurrent_scans": 2, "tenants": ["a:tok-a"],
+        })
+        httpd, port = start_server(
+            cache=new_cache("memory", None), token="srv-tok", admission=cfg
+        )
+        base = f"http://127.0.0.1:{port}"
+        try:
+            ok = RemoteDriver(base, token="tok-a", retries=0)
+            ok.scan("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            srv = httpd.service
+            assert srv.admission.admitted.value(tenant="a") == 1
+            bad = RemoteDriver(base, token="nope", retries=0)
+            with pytest.raises(RPCError, match="401"):
+                bad.scan("t", "a1", [], ScanOptions(scanners=["vuln"]))
+        finally:
+            httpd.shutdown()
+
+
+class TestShedAndDrain:
+    def test_sync_shed_carries_retry_after_and_client_retries(self):
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        _slow_scan(httpd, delay=0.15)
+        try:
+            drivers = [RemoteDriver(base) for _ in range(3)]
+            results, errors = [], []
+
+            def scan(d):
+                try:
+                    results.append(
+                        d.scan("t", "a1", [], ScanOptions(scanners=["vuln"]))
+                    )
+                except Exception as e:  # pragma: no cover - failure detail
+                    errors.append(e)
+
+            threads = [threading.Thread(target=scan, args=(d,))
+                       for d in drivers]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            assert len(results) == 3
+            # saturation really shed (1 worker, 3 concurrent 300 ms scans)
+            shed = httpd.service.admission.shed.value(
+                tenant="default", reason="concurrency"
+            )
+            assert shed >= 1
+        finally:
+            httpd.shutdown()
+
+    def test_sync_shed_response_shape(self):
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        _slow_scan(httpd, delay=0.5)
+        try:
+            bg = RemoteDriver(base)
+            th = threading.Thread(
+                target=lambda: bg.scan(
+                    "t", "a1", [], ScanOptions(scanners=["vuln"])
+                )
+            )
+            th.start()
+            time.sleep(0.15)  # the slow scan is now occupying the budget
+            d = RemoteDriver(base, retries=0)  # no retry: see the raw shed
+            with pytest.raises(RPCError, match="503"):
+                d.scan("t", "a2", [], ScanOptions(scanners=["vuln"]))
+            th.join(timeout=30)
+        finally:
+            httpd.shutdown()
+
+    def test_drain_rejects_queued_jobs_loudly(self, caplog):
+        import logging
+
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        _slow_scan(httpd, delay=0.35)
+        try:
+            d = RemoteDriver(base)
+            running = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            time.sleep(0.1)  # let the worker pick it up
+            queued = [
+                d.submit("t", f"q{i}", [], ScanOptions(scanners=["vuln"]))
+                for i in range(3)
+            ]
+            with caplog.at_level(logging.WARNING):
+                remaining = drain_and_shutdown(httpd, timeout=10)
+            assert remaining == 0
+            assert any("rejected 3 queued job" in r.message
+                       for r in caplog.records)
+            adm = httpd.service.admission
+            for sub in queued:
+                code, doc, _ = adm.result(sub["JobID"])
+                assert code == 200 and doc["Status"] == "rejected"
+                assert "draining" in doc["Error"]
+            # the running job was allowed to finish
+            code, doc, _ = adm.result(running["JobID"])
+            assert doc["Status"] == "done"
+        finally:
+            httpd.server_close()
+
+    def test_submit_while_draining_sheds(self):
+        httpd, base = _admitted_server()
+        try:
+            httpd.service.draining = True
+            d = RemoteDriver(base, retries=0)
+            with pytest.raises(RPCError, match="503"):
+                d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+        finally:
+            httpd.service.draining = False
+            httpd.shutdown()
+
+    def test_shed_rides_request_metrics_and_drain_covers_upload(self):
+        """Regressions: (a) shed replies count in the server request
+        counter/histogram — an operator computing error rates from
+        requests_total must see the 429/503s, not a healthy server;
+        (b) the in-flight gauge covers the body read, so graceful drain
+        cannot close the listener mid-upload."""
+        import socket
+
+        from trivy_tpu import rpc
+
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        service = httpd.service
+        _slow_scan(httpd, delay=0.4)
+        try:
+            occupier = threading.Thread(
+                target=lambda: RemoteDriver(base).scan(
+                    "t", "a1", [], ScanOptions(scanners=["vuln"])
+                )
+            )
+            occupier.start()
+            deadline = time.monotonic() + 5
+            while service.admission.running() == 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            probe = urllib.request.Request(
+                base + rpc.SCANNER_SCAN, data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(probe, timeout=5)
+            assert ei.value.code == 503
+            assert service.metrics.requests.value(
+                method="scan", code="503"
+            ) >= 1
+            occupier.join()
+            # (b): a stalled upload holds the in-flight gauge
+            host, port = base.split("//", 1)[1].split(":")
+            stalled = socket.create_connection((host, int(port)),
+                                               timeout=10)
+            try:
+                stalled.sendall(
+                    f"POST {rpc.SCANNER_SCAN} HTTP/1.1\r\n"
+                    f"Host: {host}\r\nContent-Length: 64\r\n\r\n".encode()
+                )
+                deadline = time.monotonic() + 5
+                while service.metrics.in_flight.value() < 1 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert service.metrics.in_flight.value() >= 1
+            finally:
+                stalled.close()
+        finally:
+            httpd.shutdown()
+
+    def test_new_breaker_does_not_clobber_open_gauge(self):
+        """Regression: breakers share the process-global gauge and the
+        generic d<N> labels — constructing a second breaker (a new
+        value-keyed shared scanner) must not wipe an open row back to 0
+        and un-shed an already-degraded fleet."""
+        from trivy_tpu.parallel.mesh import CircuitBreaker
+
+        gauge = obs_metrics.REGISTRY.gauge(
+            "trivy_tpu_device_breaker_open",
+            "1 while the per-device dispatch circuit breaker is open",
+            labelnames=("device",),
+        )
+        try:
+            gauge.set(1, device="d0")
+            CircuitBreaker(2)  # registers healthy rows for d0/d1
+            assert gauge.collect()[("d0",)] == 1.0  # still open
+            assert gauge.collect()[("d1",)] == 0.0  # new row registered
+        finally:
+            gauge.remove(device="d0")
+            gauge.remove(device="d1")
+
+    def test_slow_uploader_does_not_hold_budget_slot(self):
+        """Regression: the admission slot is acquired AFTER the request
+        body is read — a client that sends scan headers and stalls its
+        upload pins only its own connection, not the whole budget."""
+        import socket
+
+        from trivy_tpu import rpc
+
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        host, port = base.split("//", 1)[1].split(":")
+        stalled = socket.create_connection((host, int(port)), timeout=10)
+        try:
+            stalled.sendall(
+                f"POST {rpc.SCANNER_SCAN} HTTP/1.1\r\n"
+                f"Host: {host}\r\nContent-Type: application/json\r\n"
+                f"Content-Length: 4096\r\n\r\n".encode()
+            )  # ...and never send the body
+            time.sleep(0.1)
+            # with the only budget slot free, a normal client completes;
+            # pre-fix the stalled upload held the slot and this shed 503
+            d = RemoteDriver(base, retries=0)
+            resp = d.scan("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            assert resp is not None
+        finally:
+            stalled.close()
+            httpd.shutdown()
+
+    def test_keepalive_connection_survives_early_shed(self):
+        """Regression: an early reply (shed/draining) fires before the
+        POST body is read; on an HTTP/1.1 keep-alive connection the
+        leftover body used to be parsed as the next request line,
+        corrupting every request after the first shed. The handler now
+        drains small bodies, so a shed + retry reuses the socket."""
+        import http.client
+
+        from trivy_tpu import rpc
+
+        httpd, base = _admitted_server()
+        host = base.split("//", 1)[1]
+        try:
+            httpd.service.draining = True
+            conn = http.client.HTTPConnection(host, timeout=5)
+            body = json.dumps({"Target": "t", "ArtifactID": "a1",
+                               "BlobIDs": [], "Options": {}}).encode()
+            for _ in range(3):  # same socket, three shed round-trips
+                conn.request(
+                    "POST", rpc.SCANNER_SCAN, body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                r = conn.getresponse()
+                assert r.status == 503
+                r.read()
+            # and the connection still serves a clean request afterwards
+            httpd.service.draining = False
+            conn.request("GET", "/healthz")
+            r = conn.getresponse()
+            assert r.status == 200
+            assert json.loads(r.read())["Status"] == "ok"
+            conn.close()
+        finally:
+            httpd.service.draining = False
+            httpd.shutdown()
+
+    def test_oversized_unread_body_closes_connection(self):
+        """The flip side: a body too large to be worth draining gets
+        ``Connection: close`` instead of a blind multi-MB read."""
+        import http.client
+
+        from trivy_tpu import rpc
+
+        httpd, base = _admitted_server()
+        host = base.split("//", 1)[1]
+        try:
+            httpd.service.draining = True
+            conn = http.client.HTTPConnection(host, timeout=5)
+            conn.putrequest("POST", rpc.SCANNER_SCAN)
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", str(8 * 1024 * 1024))
+            conn.endheaders()
+            # send nothing beyond the headers; the server must reply and
+            # advertise the close rather than wait for 8 MiB
+            r = conn.getresponse()
+            assert r.status == 503
+            assert (r.getheader("Connection") or "").lower() == "close"
+            conn.close()
+        finally:
+            httpd.service.draining = False
+            httpd.shutdown()
+
+    def test_drain_accounting_counts_sync_scans_once(self):
+        """Regression: a sync scan holds an HTTP request AND a budget
+        slot; drain accounting sums in-flight requests with
+        ``running_jobs()`` (async only), so one sync scan is one."""
+        httpd, base = _admitted_server(max_concurrent_scans=2)
+        adm = httpd.service.admission
+        _slow_scan(httpd, delay=0.4)
+        try:
+            d = RemoteDriver(base)
+            t = threading.Thread(
+                target=d.scan,
+                args=("t", "a1", [], ScanOptions(scanners=["vuln"])),
+            )
+            t.start()
+            deadline = time.monotonic() + 5
+            while adm.running() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert adm.running() == 1
+            assert adm.running_jobs() == 0  # sync: the HTTP gauge has it
+            t.join()
+            # async jobs are the other half: they have no HTTP request
+            sub = d.submit("t", "a2", [], ScanOptions(scanners=["vuln"]))
+            deadline = time.monotonic() + 5
+            while adm.running_jobs() == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert adm.running_jobs() == 1
+            d.wait_result(sub["JobID"], timeout=30)
+            assert adm.running_jobs() == 0
+        finally:
+            httpd.shutdown()
+
+    def test_finished_job_releases_request_payload(self):
+        """A terminal job serves id/status/result; the submit request
+        document (blob-id lists can run to thousands of digests) must
+        not ride the bounded retention table."""
+        httpd, base = _admitted_server()
+        try:
+            d = RemoteDriver(base)
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            d.wait_result(sub["JobID"], timeout=30)
+            job = httpd.service.admission._finished[sub["JobID"]]
+            assert job.req is None
+            assert job.traceparent is None
+            # and the result API still answers from the retained job
+            doc = d.fetch_result(sub["JobID"])
+            assert doc["Status"] == "done"
+        finally:
+            httpd.shutdown()
+
+
+class TestSaturation:
+    def test_concurrent_multi_tenant_saturation(self):
+        """The acceptance leg: N concurrent mixed-tenant clients against
+        one admitted server — quotas enforced, everyone completes through
+        shed+retry, fair tenant service, and no leaked threads after
+        drain."""
+        cfg = resolve_admission({
+            "max_concurrent_scans": 2,
+            "tenants": ["a:tok-a", "b:tok-b"],
+        })
+        httpd, port = start_server(
+            cache=new_cache("memory", None), admission=cfg
+        )
+        base = f"http://127.0.0.1:{port}"
+        _slow_scan(httpd, delay=0.05)
+        service = httpd.service
+        per_client, n_clients = 4, 6
+        done: dict[str, int] = {"a": 0, "b": 0}
+        errors: list = []
+        lock = threading.Lock()
+
+        def client(i):
+            tenant = "a" if i % 2 == 0 else "b"
+            d = RemoteDriver(base, token=f"tok-{tenant}")
+            try:
+                for j in range(per_client):
+                    if j % 2 == 0:
+                        d.scan("t", f"c{i}-{j}", [],
+                               ScanOptions(scanners=["vuln"]))
+                    else:
+                        sub = d.submit("t", f"c{i}-{j}", [],
+                                       ScanOptions(scanners=["vuln"]))
+                        d.wait_result(sub["JobID"], timeout=60)
+                    with lock:
+                        done[tenant] += 1
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append((i, e))
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            elapsed = time.monotonic() - t0
+            assert not errors, errors
+            assert done["a"] == done["b"] == n_clients // 2 * per_client
+            # Jain fairness over per-tenant throughput: equal weights +
+            # equal work must land well above the 0.8 acceptance floor
+            rates = [done["a"] / elapsed, done["b"] / elapsed]
+            jain = sum(rates) ** 2 / (len(rates) * sum(r * r for r in rates))
+            assert jain >= 0.8
+            # the budget really throttled: admission never exceeded
+            adm = service.admission
+            assert adm.running() <= cfg.max_concurrent
+        finally:
+            drain_and_shutdown(httpd, timeout=10)
+            httpd.server_close()
+        time.sleep(0.2)
+        leaked = [t.name for t in threading.enumerate()
+                  if t.name.startswith("admission-worker")]
+        assert not leaked, f"admission workers leaked: {leaked}"
+
+
+class TestChaos:
+    def test_enqueue_fault_sheds_not_crashes(self):
+        httpd, base = _admitted_server()
+        try:
+            faults.configure("admission.enqueue:times=1")
+            d = RemoteDriver(base, retries=0)
+            with pytest.raises(RPCError, match="503"):
+                d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            assert httpd.service.admission.shed.value(
+                tenant="default", reason="enqueue-fault"
+            ) == 1
+            # disarmed: the very next submit is admitted and completes
+            faults.clear()
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            d.wait_result(sub["JobID"], timeout=30)
+        finally:
+            httpd.shutdown()
+
+    def test_enqueue_fault_retried_by_client_backoff(self):
+        httpd, base = _admitted_server()
+        try:
+            faults.configure("admission.enqueue:times=2")
+            d = RemoteDriver(base)  # full retry ladder honors Retry-After
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            d.wait_result(sub["JobID"], timeout=30)
+        finally:
+            httpd.shutdown()
+
+    def test_dequeue_fault_fails_one_job_only(self):
+        httpd, base = _admitted_server(max_concurrent_scans=1)
+        try:
+            faults.configure("admission.dequeue:at=1:times=1")
+            d = RemoteDriver(base)
+            first = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            second = d.submit("t", "a2", [], ScanOptions(scanners=["vuln"]))
+            with pytest.raises(RPCError, match="failed"):
+                d.wait_result(first["JobID"], timeout=30)
+            # the queue is not wedged: the next job still completes
+            d.wait_result(second["JobID"], timeout=30)
+            assert httpd.service.admission.jobs_c.value(status="failed") == 1
+            assert httpd.service.admission.jobs_c.value(status="done") == 1
+        finally:
+            httpd.shutdown()
+
+    def test_result_fetch_fault_500_then_recovers(self):
+        httpd, base = _admitted_server()
+        try:
+            d = RemoteDriver(base)
+            sub = d.submit("t", "a1", [], ScanOptions(scanners=["vuln"]))
+            d.wait_result(sub["JobID"], timeout=30)
+            faults.configure("job.result.fetch:times=1")
+            with pytest.raises(RPCError, match="HTTP 500"):
+                d.fetch_result(sub["JobID"])
+            assert d.fetch_result(sub["JobID"])["Status"] == "done"
+        finally:
+            httpd.shutdown()
+
+
+class TestZeroCostWhenOff:
+    def test_admission_off_allocates_nothing(self):
+        httpd, port = start_server(cache=new_cache("memory", None))
+        base = f"http://127.0.0.1:{port}"
+        try:
+            assert httpd.service.admission is None
+            assert not [t.name for t in threading.enumerate()
+                        if t.name.startswith("admission-worker")]
+            # /metrics renders no admission instrument at all
+            text = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "trivy_tpu_admission" not in text
+            # /healthz keeps the exact historical shape
+            doc = json.loads(
+                urllib.request.urlopen(f"{base}/healthz").read()
+            )
+            assert "Admission" not in doc
+            assert sorted(doc) == [
+                "InFlight", "Status", "UptimeSeconds", "Version",
+            ]
+        finally:
+            httpd.shutdown()
+
+    def test_poll_helpers_fail_fast(self):
+        # satellite: read-only polls carry the short deadline, not the
+        # 60 s retry ladder — a dead server fails a poll in seconds
+        t0 = time.monotonic()
+        with pytest.raises(RPCError):
+            get_progress("http://127.0.0.1:9", "ab" * 16)
+        with pytest.raises(RPCError):
+            get_result("http://127.0.0.1:9", "ab" * 16)
+        assert time.monotonic() - t0 < 6.0

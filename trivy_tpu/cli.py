@@ -25,6 +25,20 @@ def _interval_validator(v):
 
     return validate_interval(v, "interval")
 
+
+def _count_validator(v):
+    # non-negative integer knobs (admission budgets, quotas); the rule
+    # lives in rpc/admission.py so env-only resolution validates the same
+    from trivy_tpu.rpc.admission import validate_count
+
+    return validate_count(v, "value")
+
+
+def _seconds_validator(v):
+    from trivy_tpu.rpc.admission import validate_seconds
+
+    return validate_seconds(v, "value")
+
 SCANNERS = ["vuln", "misconfig", "secret", "license"]
 FORMATS = ["table", "json", "sarif", "cyclonedx", "spdx", "spdx-json", "github", "template", "cosign-vuln"]
 
@@ -299,6 +313,67 @@ def image_flags() -> FlagGroup:
     )
 
 
+def admission_flags() -> FlagGroup:
+    """Overload-safe multi-tenant serving (README "Multi-tenant serving"):
+    the admission queue, per-tenant quotas, and the async job API. Every
+    knob is validated at flag resolution — garbage values (including the
+    TRIVY_TPU_* env spellings) kill server startup, not the Nth request."""
+    return FlagGroup(
+        "admission",
+        [
+            Flag("max-concurrent-scans", default=0, value_type=int,
+                 config_name="admission.max-concurrent-scans",
+                 validator=_count_validator,
+                 help="concurrent-scan budget; > 0 enables admission "
+                      "control + the async job API (0 = off, today's "
+                      "unbounded behavior)"),
+            Flag("admission-queue-depth", default=None, value_type=int,
+                 config_name="admission.queue-depth",
+                 validator=_count_validator,
+                 help="max queued jobs before submits shed with 503 "
+                      "(default 64)"),
+            Flag("admission-queued-mb", default=None, value_type=int,
+                 config_name="admission.queued-mb",
+                 validator=_count_validator,
+                 help="queued-bytes budget in MB (default: "
+                      "TRIVY_TPU_HBM_BUDGET_MB, 1024, x device count — "
+                      "queue no more than one device-budget's worth; the "
+                      "arena-slab HBM proxy sizes the concurrent-scan "
+                      "budget, not this one)"),
+            Flag("tenants", default=None, is_list=True,
+                 config_name="admission.tenants",
+                 help="tenant map, comma-separated "
+                      "name:token[:weight[:max_inflight[:queued_mb]]] "
+                      "entries; tokens authenticate like --token and key "
+                      "per-tenant quotas + weighted fair dequeue "
+                      "(per-tenant quota fields override the config-wide "
+                      "--tenant-max-inflight/--tenant-queued-mb)"),
+            Flag("tenant-max-inflight", default=None, value_type=int,
+                 config_name="admission.tenant-max-inflight",
+                 validator=_count_validator,
+                 help="per-tenant concurrent-scan quota (default: the "
+                      "full concurrency budget — fairness comes from the "
+                      "weighted dequeue, quotas only cap abuse)"),
+            Flag("tenant-queued-mb", default=None, value_type=int,
+                 config_name="admission.tenant-queued-mb",
+                 validator=_count_validator,
+                 help="per-tenant queued-bytes quota in MB (default: the "
+                      "global queued-bytes budget)"),
+            Flag("job-retention", default=None, value_type=int,
+                 config_name="admission.job-retention",
+                 validator=_count_validator,
+                 help="finished async jobs kept for result polling "
+                      "(default 64; oldest evicted first)"),
+            Flag("job-deadline", default=None, value_type=float,
+                 config_name="admission.job-deadline",
+                 validator=_seconds_validator,
+                 help="default queue deadline in seconds for jobs that "
+                      "supply none (0 = queued jobs never expire); a "
+                      "client DeadlineSeconds always wins"),
+        ],
+    )
+
+
 def server_client_flags() -> FlagGroup:
     return FlagGroup(
         "client/server",
@@ -329,7 +404,7 @@ _TARGET_GROUPS = {
     "sbom": [global_flags, scan_flags, report_flags, db_flags,
              server_client_flags],
     "convert": [global_flags, report_flags],
-    "server": [global_flags, db_flags],
+    "server": [global_flags, db_flags, admission_flags],
     "clean": [global_flags],
 }
 

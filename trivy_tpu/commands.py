@@ -475,9 +475,18 @@ def _run_convert(ns, opts) -> int:
 
 
 def _run_server(ns, opts) -> int:
+    from trivy_tpu.rpc.admission import resolve_admission
     from trivy_tpu.rpc.server import serve
 
     host, _, port = ns.listen.rpartition(":")
+    # resolve the admission knob set at boot (CLI > env > derived budget):
+    # a garbage quota/tenant spec kills startup with a clear error here,
+    # never the Nth request with a 500
+    try:
+        admission = resolve_admission(opts)
+    except ValueError as e:
+        logger.error("%s", e)
+        return 1
     serve(
         host or "0.0.0.0",
         int(port),
@@ -485,6 +494,7 @@ def _run_server(ns, opts) -> int:
         token=getattr(ns, "token", "") or "",
         token_header=getattr(ns, "token_header", None) or "Trivy-Token",
         db_repository=opts.get("db_repository"),
+        admission=admission,
     )
     return 0
 

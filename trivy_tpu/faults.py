@@ -18,6 +18,11 @@ Instrumented sites (key in parentheses):
 - ``rpc.post`` (route path) — one client HTTP attempt
 - ``walker.read`` (relative path) — file read between walk and analysis
 - ``misconf.eval`` (file path) — per-file misconfiguration evaluation
+- ``admission.enqueue`` (tenant name) — job enqueue into the server's
+  admission queue (a fault here must shed loudly, never crash the server)
+- ``admission.dequeue`` (tenant name) — job handoff from queue to worker
+  (a fault here must fail that one job terminally, never wedge the queue)
+- ``job.result.fetch`` (job id) — async job result lookup
 
 Spec grammar (``--fault-inject`` / ``TRIVY_TPU_FAULT_INJECT``), clauses
 comma-separated::

@@ -40,7 +40,13 @@ class Flag:
         names = [f"--{self.name}"]
         if self.short:
             names.insert(0, f"-{self.short}")
-        kw: dict = {"help": self.help, "default": None, "dest": self.dest}
+        # argparse %-formats help strings; a literal % (e.g. "progress %")
+        # must be escaped or --help dies with a ValueError
+        kw: dict = {
+            "help": self.help.replace("%", "%%"),
+            "default": None,
+            "dest": self.dest,
+        }
         if self.value_type is bool:
             kw["action"] = "store_true"
             kw["default"] = None
@@ -102,9 +108,19 @@ class Flag:
                 return raw
             return str(raw).lower() in ("1", "true", "yes", "on")
         if self.value_type is int:
-            return int(raw)
+            try:
+                return int(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"--{self.name}: not an integer: {raw!r}"
+                ) from None
         if self.value_type is float:
-            return float(raw)
+            try:
+                return float(raw)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"--{self.name}: not a number: {raw!r}"
+                ) from None
         value = str(raw)
         if self.choices and value not in self.choices:
             raise ValueError(

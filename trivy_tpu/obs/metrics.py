@@ -80,6 +80,14 @@ class _ValueMetric(_Metric):
         with self._lock:
             return self._values.get(self._key(labels), 0.0)
 
+    def collect(self) -> dict[tuple[str, ...], float]:
+        """Snapshot of every recorded label set -> value (label values in
+        declared ``labelnames`` order). Lets decision logic — the admission
+        controller reading breaker/utilization gauges — consume live state
+        without parsing the text exposition."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> list[str]:
         with self._lock:
             items = sorted(self._values.items())
@@ -99,6 +107,13 @@ class Gauge(_ValueMetric):
     def set(self, value: float, **labels) -> None:
         with self._lock:
             self._values[self._key(labels)] = float(value)
+
+    def setdefault(self, value: float, **labels) -> None:
+        """Register a label row only if absent — initializers (a new
+        CircuitBreaker publishing healthy rows) must not clobber live
+        state another writer already holds under the same labels."""
+        with self._lock:
+            self._values.setdefault(self._key(labels), float(value))
 
     def dec(self, amount: float = 1, **labels) -> None:
         self.inc(-amount, **labels)

@@ -10,6 +10,8 @@ rounding) and printed as a one-line verdict::
 
 Buckets name the *cause* a pipeline is slow:
 
+- ``queue-bound`` — the scan sat in the server's admission queue before
+  it was allowed to start
 - ``feed-starved`` — the device loop sat waiting for host batches (walk,
   read, chunk/pack could not keep the accelerator fed)
 - ``upload-bound`` — time in dispatch/device_put (host→device link)
@@ -24,6 +26,7 @@ from trivy_tpu.obs import TraceContext
 
 # trailing stage-name component -> attribution bucket
 BUCKETS = {
+    "queue_wait": "queue-bound",  # admission-queue wait before the scan ran
     "feed_wait": "feed-starved",
     "dispatch": "upload-bound",
     "device_wait": "device-bound",
@@ -37,6 +40,7 @@ BUCKETS = {
 
 # stable display order for verdict lines
 ORDER = [
+    "queue-bound",
     "feed-starved",
     "upload-bound",
     "device-bound",

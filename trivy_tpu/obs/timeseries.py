@@ -288,6 +288,43 @@ def _registry():
     return obs_metrics.REGISTRY
 
 
+def live_utilization() -> dict:
+    """Snapshot of the process-level utilization gauges this module
+    maintains, for decision logic (the admission controller's admit/shed
+    rules read exactly these). Keys whose gauge carries no live value are
+    ``None`` — the caller must treat "no telemetry" and "telemetry says
+    idle" differently (an unsampled vuln-only server is not saturated).
+
+    - ``link_mbs``: instantaneous host->device bandwidth
+    - ``busy_max``: max per-device busy fraction across sampled devices
+    - ``arena_free``: free slabs in the most recent sampled feed arena
+    - ``samplers``: live sampler count (0 = nothing sampling right now)
+    """
+    reg = _registry()
+    link = reg.gauge(
+        "trivy_tpu_link_mbs",
+        "Instantaneous host->device link bandwidth (MB/s)",
+    ).collect()
+    arena = reg.gauge(
+        "trivy_tpu_arena_free_slabs",
+        "Free slabs in the secret feed's chunk arena",
+    ).collect()
+    busy = reg.gauge(
+        "trivy_tpu_device_busy_ratio",
+        "Fraction of the last sampling interval the device had "
+        "work in flight",
+        labelnames=("device",),
+    ).collect()
+    with _live_lock:
+        samplers = _live_samplers
+    return {
+        "link_mbs": next(iter(link.values()), None),
+        "arena_free": next(iter(arena.values()), None),
+        "busy_max": max(busy.values()) if busy else None,
+        "samplers": samplers,
+    }
+
+
 # live-sampler accounting for the process-level gauges: the unlabeled
 # gauges (link MB/s, arena free slabs) and the per-device busy ratios are
 # "most recent sampled value in this process" — concurrent scans overwrite

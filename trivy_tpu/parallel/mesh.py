@@ -111,6 +111,16 @@ class CircuitBreaker:
         self._backoff = [probe_backoff] * n_devices
         self._probing = [False] * n_devices  # one half-open probe at a time
         self._probe_at = [0.0] * n_devices  # when that probe was handed out
+        # register a healthy (0) row per device up front: readers of the
+        # breaker gauge — the admission controller's all-devices-open
+        # early-shed, operators scraping /metrics — must see every device
+        # the breaker covers, not only the ones that have ever failed.
+        # setdefault, not set: breakers share the process-global gauge and
+        # generic d<N> labels, so a second breaker's construction (e.g. a
+        # new value-keyed shared scanner) must not wipe an open row and
+        # un-shed an already-degraded fleet
+        for lbl in self.labels:
+            _BREAKER_OPEN.setdefault(0, device=lbl)
 
     def record_failure(self, i: int) -> None:
         _DEVICE_FAILURES.inc(device=self.labels[i])

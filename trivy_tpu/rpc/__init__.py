@@ -27,5 +27,18 @@ SCAN_PROGRESS_SUFFIX = "/progress"
 def scan_progress_path(trace_id: str) -> str:
     return f"{SCAN_PROGRESS_PREFIX}{trace_id}{SCAN_PROGRESS_SUFFIX}"
 
+
+# async job API (admission-controlled servers): POST /scan/submit enqueues
+# a Scanner.Scan request and returns a job id (the scan's trace id) plus
+# its queue position; GET /scan/<job_id>/result polls it (202 while
+# queued/running, 200 with the scan response once done, bounded
+# retention); GET /scan/<job_id>/progress is the live-progress half
+SCAN_SUBMIT = "/scan/submit"
+SCAN_RESULT_SUFFIX = "/result"
+
+
+def scan_result_path(job_id: str) -> str:
+    return f"{SCAN_PROGRESS_PREFIX}{job_id}{SCAN_RESULT_SUFFIX}"
+
 # ref: pkg/flag/server_flags.go default token header
 DEFAULT_TOKEN_HEADER = "Trivy-Token"

@@ -7,9 +7,11 @@ connectivity errors and 5xx — the reference retries only on
 twirp.Unavailable (ref: retry.go:17-41); connection refused / 502 / 503 /
 504 map to the same class here. The backoff is full-jitter (a fleet of
 clients retrying a recovering server must not synchronize into a thundering
-herd), honors ``Retry-After`` on 503 (the server sends it while draining),
-and the whole retry loop is capped by a wall-clock deadline — 10 retries ×
-5 s of zero-jitter sleep used to stall a caller ~50 s.
+herd), honors ``Retry-After`` on 503 and 429 (the server sends it while
+draining or shedding over-budget/over-quota scans), and the whole retry
+loop is capped by a wall-clock deadline — 10 retries × 5 s of zero-jitter
+sleep used to stall a caller ~50 s. Read-only polls (progress, job
+results) skip the ladder entirely and fail fast on :data:`POLL_TIMEOUT`.
 """
 
 from __future__ import annotations
@@ -35,7 +37,17 @@ PROGRESS_POLL_SECS = 1.0
 MAX_RETRIES = 10  # ref: retry.go retry count
 MAX_BACKOFF = 5.0  # per-sleep cap (jittered: actual sleep ~U(0, backoff))
 RETRY_DEADLINE = 60.0  # total retry wall-clock cap per request
-_RETRYABLE_HTTP = {502, 503, 504}
+# 429 joins the retryable set: an admission-controlled server sheds
+# over-quota tenants with 429 + Retry-After, and the same backoff that
+# rides out a draining 503 turns that into a later successful attempt
+_RETRYABLE_HTTP = {429, 502, 503, 504}
+_RETRY_AFTER_HTTP = {429, 503}
+
+# read-only polls (progress, job results) get a short timeout and NO
+# retry ladder: a wedged server must fail a poll fast — the next tick (or
+# the caller's own poll loop) retries anyway, and a poll inheriting the
+# full 60 s RETRY_DEADLINE used to stall the --live line for a minute
+POLL_TIMEOUT = 5.0
 
 
 class RPCError(Exception):
@@ -80,8 +92,10 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
         except urllib.error.HTTPError as e:
             if e.code in _RETRYABLE_HTTP and attempt < retries:
                 last = e
-                if e.code == 503:
-                    # a draining/overloaded server says when to come back
+                if e.code in _RETRY_AFTER_HTTP:
+                    # a draining/overloaded/shedding server says when to
+                    # come back (admission sheds carry a drain-rate-derived
+                    # Retry-After on both 503 and 429)
                     try:
                         ra = e.headers.get("Retry-After")
                         retry_after = float(ra) if ra else None
@@ -125,25 +139,56 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
     raise RPCError(f"{path}: retries exhausted: {last}")
 
 
+def _get_json(url: str, token: str, token_header: str, timeout: float,
+              what: str) -> tuple[int, dict, dict]:
+    """One read-only GET poll: (status, body, headers). No retry ladder
+    and the short :data:`POLL_TIMEOUT`-style timeout — polls must fail
+    fast, the caller's loop is the retry."""
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header(token_header, token)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (
+                resp.status,
+                json.loads(resp.read() or b"{}"),
+                dict(resp.headers),
+            )
+    except urllib.error.HTTPError as e:
+        raise RPCError(f"{what}: HTTP {e.code}") from e
+    except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+        raise RPCError(f"{what}: {e}") from e
+
+
 def get_progress(server: str, trace_id: str, token: str = "",
                  token_header: str = rpc.DEFAULT_TOKEN_HEADER,
-                 timeout: float = 5.0) -> dict:
+                 timeout: float = POLL_TIMEOUT) -> dict:
     """One poll of the server's live progress API
     (``GET /scan/<trace_id>/progress``). Raises :class:`RPCError` on an
     unknown trace id or connectivity failure — deliberately no retry loop:
     progress polling is advisory and the next tick polls again anyway."""
     base = server if "://" in server else f"http://{server}"
     url = base.rstrip("/") + rpc.scan_progress_path(trace_id)
-    req = urllib.request.Request(url)
-    if token:
-        req.add_header(token_header, token)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read() or b"{}")
-    except urllib.error.HTTPError as e:
-        raise RPCError(f"progress {trace_id}: HTTP {e.code}") from e
-    except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
-        raise RPCError(f"progress {trace_id}: {e}") from e
+    _, doc, _ = _get_json(
+        url, token, token_header, timeout, f"progress {trace_id}"
+    )
+    return doc
+
+
+def get_result(server: str, job_id: str, token: str = "",
+               token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+               timeout: float = POLL_TIMEOUT) -> dict:
+    """One poll of the async job API (``GET /scan/<job_id>/result``).
+    Returns the job document — ``Status`` is ``queued``/``running`` (the
+    202 states, with ``QueuePosition``/``RetryAfterSeconds`` while
+    queued) or a terminal ``done``/``failed``/``expired``/``rejected``.
+    Same fail-fast discipline as :func:`get_progress`."""
+    base = server if "://" in server else f"http://{server}"
+    url = base.rstrip("/") + rpc.scan_result_path(job_id)
+    _, doc, _ = _get_json(
+        url, token, token_header, timeout, f"result {job_id}"
+    )
+    return doc
 
 
 class RemoteCache:
@@ -212,6 +257,114 @@ class RemoteDriver:
             token=self.token, token_header=self.token_header,
         )
 
+    def _scan_payload(self, target, artifact_id, blob_ids, options,
+                     want_trace: bool) -> dict:
+        return {
+            "Target": target,
+            "ArtifactID": artifact_id,
+            "BlobIDs": blob_ids,
+            "Options": {
+                "Scanners": list(options.scanners),
+                "ListAllPkgs": options.list_all_pkgs,
+            },
+            "WantTrace": want_trace,
+        }
+
+    # -- async job API (admission-controlled servers) -----------------------
+
+    def submit(self, target: str, artifact_id: str, blob_ids: list[str],
+               options: ScanOptions,
+               deadline_s: float | None = None) -> dict:
+        """Submit a scan to the server's admission queue
+        (``POST /scan/submit``); returns the submit document (``JobID``,
+        ``QueuePosition``, ...). Sheds (429/503 + Retry-After) ride the
+        normal full-jitter retry loop, so a busy-but-draining queue turns
+        into a later accepted submit, not an error."""
+        import os as _os
+
+        ctx = obs.current()
+        payload = self._scan_payload(
+            target, artifact_id, blob_ids, options, bool(ctx.enabled)
+        )
+        if deadline_s is not None:
+            payload["DeadlineSeconds"] = deadline_s
+        # submit is NOT idempotent on the wire (it enqueues); the key is
+        # stable across this retry loop's attempts, so a retry after a
+        # lost 202 returns the already-enqueued job instead of a twin
+        # that would burn a budget slot nobody polls
+        payload["SubmitKey"] = _os.urandom(8).hex()
+        return _post(
+            self.base, rpc.SCAN_SUBMIT, payload, self.token,
+            self.token_header, self.timeout, self.retries, self.deadline,
+        )
+
+    def fetch_result(self, job_id: str) -> dict:
+        """One fail-fast poll of a submitted job's result document."""
+        return get_result(
+            self.base, job_id, token=self.token,
+            token_header=self.token_header,
+        )
+
+    def wait_result(self, job_id: str, timeout: float = 300.0,
+                    poll: float = 0.25) -> dict:
+        """Poll a job to a terminal state and return the scan response.
+        Honors the server's queued-state ``RetryAfterSeconds`` as the
+        poll cadence floor; raises :class:`RPCError` on ``failed``/
+        ``expired``/``rejected`` jobs or when ``timeout`` elapses first."""
+        deadline = time.monotonic() + timeout
+        misses = 0
+        while True:
+            try:
+                doc = self.fetch_result(job_id)
+            except RPCError:
+                # one transient blip (proxy restart, a single wedged
+                # 5 s poll) must not abort a job that is still running
+                # server-side and burning a budget slot; but a permanent
+                # failure (unknown job id, dead server) should surface
+                # after a few polls, not linger to the full timeout
+                misses += 1
+                if misses > 3 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(min(poll, max(0.05,
+                                         deadline - time.monotonic())))
+                continue
+            misses = 0
+            status = doc.get("Status")
+            if status == "done":
+                return doc.get("Result") or {}
+            if status in ("failed", "expired", "rejected"):
+                raise RPCError(
+                    f"job {job_id}: {status}: {doc.get('Error', '')}"
+                )
+            if time.monotonic() >= deadline:
+                raise RPCError(
+                    f"job {job_id}: still {status} after {timeout:.0f}s"
+                )
+            delay = poll
+            if status == "queued" and doc.get("RetryAfterSeconds"):
+                # the server knows its drain rate better than we do, but
+                # a poll is cheap — cap the server's hint at 2 s so a
+                # pessimistic estimate can't make a finished job linger
+                delay = min(2.0, max(poll, float(doc["RetryAfterSeconds"])))
+            time.sleep(min(delay, max(0.05, deadline - time.monotonic())))
+
+    def scan_async(self, target: str, artifact_id: str,
+                   blob_ids: list[str], options: ScanOptions,
+                   deadline_s: float | None = None,
+                   timeout: float = 300.0):
+        """Submit + poll + parse: the async-shaped equivalent of
+        :meth:`scan` for large artifacts against admission-controlled
+        servers."""
+        sub = self.submit(target, artifact_id, blob_ids, options,
+                          deadline_s=deadline_s)
+        resp = self.wait_result(sub["JobID"], timeout=timeout)
+        ctx = obs.current()
+        if ctx.enabled and resp.get("Trace"):
+            ctx.ingest_remote(resp["Trace"])
+        results = [Result.from_dict(r) for r in resp.get("Results", [])]
+        os_info = OS.from_dict(resp["OS"]) if resp.get("OS") else None
+        return results, os_info
+
     def _poll_progress(self, ctx, stop: threading.Event) -> None:
         """Background join of the server's live progress while the scan
         RPC is in flight: each snapshot folds into the local ScanProgress
@@ -251,16 +404,10 @@ class RemoteDriver:
                 resp = _post(
                     self.base,
                     rpc.SCANNER_SCAN,
-                    {
-                        "Target": target,
-                        "ArtifactID": artifact_id,
-                        "BlobIDs": blob_ids,
-                        "Options": {
-                            "Scanners": list(options.scanners),
-                            "ListAllPkgs": options.list_all_pkgs,
-                        },
-                        "WantTrace": bool(ctx.enabled),
-                    },
+                    self._scan_payload(
+                        target, artifact_id, blob_ids, options,
+                        bool(ctx.enabled),
+                    ),
                     self.token,
                     self.token_header,
                     self.timeout,

@@ -9,12 +9,20 @@ record into disjoint span tables — and long scans emit heartbeat progress
 logs. Detection runs server-side against the server's cache + advisory DB;
 analysis stays client-side (ref: pkg/commands/artifact/run.go:348-355
 split).
+
+With admission control enabled (:mod:`trivy_tpu.rpc.admission`,
+``--max-concurrent-scans > 0``) the server becomes an overload-safe
+multi-tenant front end: synchronous scans are budget-gated (shed with
+429/503 + Retry-After instead of competing for HBM), ``POST /scan/submit``
++ ``GET /scan/<id>/result`` form the async job API (the existing progress
+route is the live-poll half), and draining rejects queued jobs loudly.
 """
 
 from __future__ import annotations
 
 import hmac
 import json
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -54,8 +62,28 @@ def _progress_wire(snap: dict) -> dict:
     return doc
 
 # request-body ceiling; blobs are analysis metadata, not file contents, so
-# 256 MiB is generous headroom while bounding a hostile Content-Length
+# 256 MiB is generous headroom while bounding a hostile Content-Length.
+# Overridable via TRIVY_TPU_MAX_REQUEST_BYTES, validated once at server
+# construction (garbage env kills boot, not the Nth request)
 MAX_REQUEST_BYTES = 256 * 1024 * 1024
+ENV_MAX_REQUEST_BYTES = "TRIVY_TPU_MAX_REQUEST_BYTES"
+
+# biggest unread POST body worth draining to keep an HTTP/1.1 connection
+# alive after an early reply (shed, 401, draining); larger bodies close
+# the connection instead of being read just to keep a socket warm
+DRAIN_BODY_MAX = 1 * 1024 * 1024
+
+
+def _resolve_max_request_bytes() -> int:
+    from trivy_tpu.rpc.admission import validate_count
+
+    raw = os.environ.get(ENV_MAX_REQUEST_BYTES, "")
+    if not raw:
+        return MAX_REQUEST_BYTES
+    v = validate_count(raw, ENV_MAX_REQUEST_BYTES)
+    if v == 0:
+        raise ValueError(f"{ENV_MAX_REQUEST_BYTES}: must be > 0, got {raw!r}")
+    return v
 
 
 class DBReloader:
@@ -202,17 +230,29 @@ class ServerMetrics:
 class ScanServer:
     """Service implementation bound to a cache and a local driver."""
 
-    def __init__(self, cache, vuln_client=None):
+    def __init__(self, cache, vuln_client=None, admission=None):
+        from trivy_tpu.rpc.admission import AdmissionController, resolve_admission
         from trivy_tpu.scanner.local_driver import LocalDriver
 
         self.cache = cache
         self.driver = LocalDriver(cache, vuln_client=vuln_client)
-        # validate the telemetry cadence once at construction: a garbage
-        # TRIVY_TPU_TELEMETRY_INTERVAL must kill the server at boot with a
-        # clear error, not every scan request with a 500
+        # validate the telemetry cadence AND the request/admission limits
+        # once at construction: garbage TRIVY_TPU_TELEMETRY_INTERVAL /
+        # _MAX_REQUEST_BYTES / admission env must kill the server at boot
+        # with a clear error, not every scan request with a 500
         self.telemetry_interval = obs_timeseries.default_interval()
+        self.max_request_bytes = _resolve_max_request_bytes()
         self.reloader: DBReloader | None = None
         self.metrics = ServerMetrics()
+        # admission control (trivy_tpu/rpc/admission.py): an explicit
+        # AdmissionConfig wins, else env resolution; disabled configs
+        # allocate NOTHING — no worker threads, no per-tenant state, no
+        # admission metrics — so an unadmitted server is byte-identical
+        # to one predating the controller
+        cfg = admission if admission is not None else resolve_admission()
+        self.admission = (
+            AdmissionController(self, cfg).start() if cfg.enabled else None
+        )
         self.started = time.time()
         # graceful-shutdown state: while draining, /healthz reports
         # "draining" (load balancers stop routing) and new RPC requests
@@ -250,7 +290,9 @@ class ScanServer:
 
     # -- service methods (JSON dict in/out) ---------------------------------
 
-    def scan(self, req: dict, traceparent: str | None = None) -> dict:
+    def scan(self, req: dict, traceparent: str | None = None,
+             trace_id: str | None = None, queue_wait_s: float | None = None,
+             tenant: str | None = None) -> dict:
         options = ScanOptions(
             scanners=req.get("Options", {}).get("Scanners", ["vuln"]),
             list_all_pkgs=bool(req.get("Options", {}).get("ListAllPkgs")),
@@ -261,14 +303,24 @@ class ScanServer:
         # the aggregates feed the shared /metrics registry afterwards. When
         # the client sent a traceparent header, this request JOINS that
         # trace — same trace id, root spans parented under the client's
-        # rpc.scan span — instead of minting a fresh context
+        # rpc.scan span — instead of minting a fresh context. Async jobs
+        # pass an explicit trace_id (their job id) so the progress/result
+        # APIs share one key even when the submitter sent no traceparent
         joined = obs.parse_traceparent(traceparent)
         with obs.scan_context(
             name=f"server-scan:{target}",
             enabled=True,
-            trace_id=joined[0] if joined else None,
+            trace_id=joined[0] if joined else trace_id,
             parent_span_id=joined[1] if joined else None,
         ) as ctx:
+            if queue_wait_s is not None:
+                # the admission queue wait becomes a first-class span: it
+                # rides --trace-out, folds into the stall verdict as the
+                # `queue-bound` bucket, and ships back in the Trace block
+                ctx.add("admission.queue_wait", queue_wait_s)
+                ctx.count("admission.queued_ms", int(queue_wait_s * 1e3))
+            if tenant is not None:
+                ctx.count(f"admission.tenant.{tenant}")
             # live telemetry: one sampler per server-side scan (cadence via
             # TRIVY_TPU_TELEMETRY_INTERVAL, 0 disables) feeding the counter
             # tracks shipped back in the Trace block and the process gauges
@@ -363,10 +415,13 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             import gzip as _gzip
 
             self._status = code
+            self._drain_unread_body()
             body = json.dumps(payload).encode()
             accepts_gzip = "gzip" in self.headers.get("Accept-Encoding", "")
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            if self.close_connection:
+                self.send_header("Connection", "close")
             for name, value in (headers or {}).items():
                 self.send_header(name, value)
             if accepts_gzip and len(body) > 1024:
@@ -376,16 +431,60 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             self.end_headers()
             self.wfile.write(body)
 
+        def _drain_unread_body(self) -> None:
+            """An early reply (shed, 401, draining, bad route) fires
+            before ``_read_body``, leaving the POSTed body unread on the
+            HTTP/1.1 keep-alive socket — where the next request parse
+            would misread it as a request line and corrupt the
+            connection. Sheds are the designed steady-state overload
+            answer, so drain small bodies and keep the connection alive
+            (the Retry-After retry reuses it); anything over
+            :data:`DRAIN_BODY_MAX` closes instead."""
+            if self.command != "POST" or getattr(
+                self, "_body_consumed", False
+            ):
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0") or 0)
+            except ValueError:
+                length = -1
+            if length == 0:
+                return
+            if 0 < length <= DRAIN_BODY_MAX:
+                try:
+                    self.rfile.read(length)
+                    self._body_consumed = True
+                    return
+                except OSError:
+                    pass
+            self.close_connection = True
+
         def _token_ok(self) -> bool:
             """Constant-time token check shared by every authenticated
-            route — one implementation, so the RPC POSTs and the progress
-            GET cannot drift apart."""
+            route — one implementation, so the RPC POSTs and the per-scan
+            GETs cannot drift apart. On a token-protected server, tenant
+            tokens (admission control's token->tenant map) authenticate
+            alongside the server token; every candidate is compared so
+            timing reveals neither which token matched nor how much of
+            the tenant table was walked. A server WITHOUT ``--token``
+            stays open even with tenants configured — tenants alone buy
+            fair scheduling (unmatched requests share the ``default``
+            tenant), not authentication."""
             if not token:
                 return True
-            return hmac.compare_digest(
-                self.headers.get(token_header, "").encode("latin-1", "replace"),
+            presented = self.headers.get(token_header, "")
+            ok = hmac.compare_digest(
+                presented.encode("latin-1", "replace"),
                 token.encode("latin-1", "replace"),
             )
+            if server.admission is not None:
+                # the tenant walk runs unconditionally (no early exit on
+                # a server-token hit) and is the SAME constant-time
+                # matcher tenant_for uses, so auth and tenant resolution
+                # cannot drift
+                if server.admission.match_token(presented) is not None:
+                    ok = True
+            return ok
 
         def _reply_text(self, code: int, body: bytes, content_type: str) -> None:
             self._status = code
@@ -401,13 +500,18 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
 
                 # liveness plus the numbers an operator checks first:
                 # version, uptime, and the in-flight request count; while
-                # draining, Status flips so load balancers stop routing
-                self._reply(200, {
+                # draining, Status flips so load balancers stop routing.
+                # Admission-controlled servers add their queue snapshot;
+                # unadmitted servers keep the exact historical shape
+                doc = {
                     "Status": "draining" if server.draining else "ok",
                     "Version": __version__,
                     "UptimeSeconds": round(time.time() - server.started, 1),
                     "InFlight": int(server.metrics.in_flight.value()),
-                })
+                }
+                if server.admission is not None:
+                    doc["Admission"] = server.admission.doc()
+                self._reply(200, doc)
                 return
             if self.path == rpc.VERSION:
                 from trivy_tpu import __version__
@@ -431,9 +535,12 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 # unlike the aggregate /healthz and /metrics probes, this
                 # route exposes per-scan activity keyed by trace id, so a
                 # token-protected server requires the token here too (the
-                # client helper already sends it)
+                # client helper already sends it). The token check comes
+                # BEFORE the trace-id lookup and fails with a uniform 403
+                # either way: an unauthenticated probe must not be able to
+                # oracle which trace ids exist from a 403/404 split
                 if not self._token_ok():
-                    self._reply(401, {"error": "invalid token"})
+                    self._reply(403, {"error": "invalid token"})
                     return
                 trace_id = self.path[
                     len(rpc.SCAN_PROGRESS_PREFIX): -len(rpc.SCAN_PROGRESS_SUFFIX)
@@ -444,9 +551,42 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                     return
                 self._reply(200, {"TraceID": trace_id, **_progress_wire(snap)})
                 return
+            if self.path.startswith(rpc.SCAN_PROGRESS_PREFIX) and (
+                self.path.endswith(rpc.SCAN_RESULT_SUFFIX)
+            ):
+                # async job result poll — same 403-before-lookup order as
+                # the progress route (job ids are trace ids)
+                if not self._token_ok():
+                    self._reply(403, {"error": "invalid token"})
+                    return
+                if server.admission is None:
+                    self._reply(404, {
+                        "error": "async job API requires admission control "
+                                 "(--max-concurrent-scans > 0)"
+                    })
+                    return
+                job_id = self.path[
+                    len(rpc.SCAN_PROGRESS_PREFIX): -len(rpc.SCAN_RESULT_SUFFIX)
+                ]
+                try:
+                    code, payload, headers = server.admission.result(job_id)
+                except Exception as e:
+                    logger.warning("job result fetch %s failed: %s",
+                                   job_id, e)
+                    self._reply(500, {"error": str(e)})
+                    return
+                self._reply(code, payload, headers=headers or None)
+                return
             self._reply(404, {"error": "not found"})
 
         def do_POST(self):
+            # per-REQUEST flag on a per-CONNECTION handler instance:
+            # keep-alive reuses the handler, so a stale True from the
+            # previous request would skip the drain and desync the socket
+            self._body_consumed = False
+            if self.path == rpc.SCAN_SUBMIT:
+                self._handle_submit()
+                return
             method = _ROUTES.get(self.path)
             if method is None:
                 self._reply(404, {"error": f"no such route: {self.path}"})
@@ -462,11 +602,52 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             if not self._token_ok():
                 self._reply(401, {"error": "invalid token"})
                 return
+            adm = server.admission
+            tenant = None
+            reply_headers = None
             m = server.metrics
+            # in-flight covers the BODY READ too: a slow upload must keep
+            # drain_and_shutdown waiting (the pre-admission clean-drain
+            # guarantee), even though the admission slot is only acquired
+            # after the body is fully read — N trickling uploads may pin
+            # their connections, never the concurrency budget
             m.in_flight.inc()
             t0 = time.perf_counter()
             try:
-                code, payload = self._dispatch(method)
+                raw, body_err = self._read_body()
+                # admission gate for synchronous scans: over-budget
+                # requests shed with 429/503 + Retry-After instead of
+                # competing for arena slabs and HBM (the client's
+                # full-jitter backoff turns the Retry-After into a later
+                # successful attempt)
+                shed = None
+                if adm is not None and method == "scan" \
+                        and body_err is None:
+                    from trivy_tpu.rpc.admission import SHED_STATUS
+
+                    t_obj = adm.tenant_for(
+                        self.headers.get(token_header, "")
+                    )
+                    reason = adm.try_acquire(t_obj)
+                    if reason is not None:
+                        ra = adm.retry_after()
+                        shed = (SHED_STATUS[reason], {
+                            "error": f"admission: {reason}",
+                            "Tenant": t_obj.name,
+                            "RetryAfterSeconds": ra,
+                        })
+                        reply_headers = {"Retry-After": str(ra)}
+                    else:
+                        tenant = t_obj
+                if shed is not None:
+                    # sheds ride the same request counter/histogram as
+                    # admitted traffic — an operator computing error
+                    # rates from requests_total must see the 429/503s
+                    code, payload = shed
+                else:
+                    code, payload = self._dispatch(
+                        method, tenant=tenant, raw=raw, err=body_err
+                    )
             finally:
                 # EVERY piece of request accounting (in-flight gauge,
                 # request counter, latency histogram) finalizes BEFORE the
@@ -475,30 +656,118 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 # completed — not a stale in-flight 1 or a missing count
                 # from bookkeeping racing the socket write
                 m.in_flight.dec()
+                if tenant is not None:
+                    adm.release(tenant)
             m.requests.inc(method=method, code=str(code))
             m.request_seconds.observe(
                 time.perf_counter() - t0, method=method
             )
-            self._reply(code, payload)
+            self._reply(code, payload, headers=reply_headers)
 
-        def _dispatch(self, method) -> tuple[int, dict]:
-            """Run one RPC method; returns (status, payload) and never
-            raises — the reply and the request metrics are the caller's."""
+        def _handle_submit(self) -> None:
+            """POST /scan/submit — the async half of the job API."""
+            if server.draining:
+                self._reply(
+                    503, {"error": "server is draining"},
+                    headers={"Retry-After": "1"},
+                )
+                return
+            if not self._token_ok():
+                self._reply(401, {"error": "invalid token"})
+                return
+            if server.admission is None:
+                self._reply(404, {
+                    "error": "async job API requires admission control "
+                             "(--max-concurrent-scans > 0)"
+                })
+                return
+            raw, err = self._read_body()
+            if err is not None:
+                self._reply(*err)
+                return
             try:
-                length = int(self.headers.get("Content-Length", "0"))
-                if length < 0 or length > MAX_REQUEST_BYTES:
-                    return 413, {"error": "request too large"}
-                raw = self.rfile.read(length)
-                if self.headers.get("Content-Encoding") == "gzip":
-                    import gzip as _gzip
-                    import io as _io
+                req = json.loads(raw or b"{}")
+            except ValueError as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            if not isinstance(req, dict):
+                # valid JSON but not an object ([1,2], "x", null) would
+                # TypeError below and drop the connection instead of
+                # answering — the _read_body contract is an HTTP error
+                self._reply(400, {
+                    "error": "bad request: body must be a JSON object"
+                })
+                return
+            deadline_s = req.pop("DeadlineSeconds", None)
+            if deadline_s is not None:
+                try:
+                    deadline_s = float(deadline_s)
+                    if deadline_s <= 0:
+                        raise ValueError
+                except (TypeError, ValueError):
+                    self._reply(400, {
+                        "error": "DeadlineSeconds must be a number > 0"
+                    })
+                    return
+            submit_key = req.pop("SubmitKey", None)
+            tenant = server.admission.tenant_for(
+                self.headers.get(token_header, "")
+            )
+            code, payload, headers = server.admission.submit(
+                req, tenant, len(raw),
+                traceparent=self.headers.get("traceparent"),
+                deadline_s=deadline_s,
+                submit_key=str(submit_key) if submit_key else None,
+            )
+            server.metrics.requests.inc(method="submit", code=str(code))
+            self._reply(code, payload, headers=headers or None)
 
+        def _read_body(self):
+            """Bounded request-body read; returns (raw, None) or
+            (None, (status, payload)) on malformed/oversized input —
+            never raises, so every POST route (the sync dispatch AND the
+            submit route) answers garbage with an HTTP error instead of
+            a dropped connection."""
+            limit = server.max_request_bytes
+            try:
+                length = int(self.headers.get("Content-Length", "0") or 0)
+            except ValueError:
+                return None, (400, {"error": "bad Content-Length"})
+            if length < 0 or length > limit:
+                return None, (413, {"error": "request too large"})
+            try:
+                raw = self.rfile.read(length)
+            except OSError as e:
+                # client reset mid-body; the stream position is now
+                # undefined, so the connection can't be reused either
+                self.close_connection = True
+                self._body_consumed = True
+                return None, (400, {"error": f"body read failed: {e}"})
+            self._body_consumed = True
+            if self.headers.get("Content-Encoding") == "gzip":
+                import gzip as _gzip
+                import io as _io
+
+                try:
                     # stream-decompress with a cap: checking size after a
                     # full decompress would let a gzip bomb OOM the server
                     with _gzip.GzipFile(fileobj=_io.BytesIO(raw)) as gz:
-                        raw = gz.read(MAX_REQUEST_BYTES + 1)
-                    if len(raw) > MAX_REQUEST_BYTES:
-                        return 413, {"error": "request too large"}
+                        raw = gz.read(limit + 1)
+                except (OSError, EOFError) as e:  # BadGzipFile is OSError
+                    return None, (400, {"error": f"bad gzip body: {e}"})
+                if len(raw) > limit:
+                    return None, (413, {"error": "request too large"})
+            return raw, None
+
+        def _dispatch(self, method, tenant=None, raw=None,
+                      err=None) -> tuple[int, dict]:
+            """Run one RPC method; returns (status, payload) and never
+            raises — the reply and the request metrics are the caller's.
+            The body is read by ``do_POST`` (before the admission gate)
+            and passed in as ``raw``/``err``."""
+            try:
+                if err is not None:
+                    return err
                 req = json.loads(raw or b"{}")
                 reloader = server.reloader
                 if reloader is not None:
@@ -506,7 +775,8 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 try:
                     if method == "scan":
                         resp = server.scan(
-                            req, traceparent=self.headers.get("traceparent")
+                            req, traceparent=self.headers.get("traceparent"),
+                            tenant=tenant.name if tenant else None,
                         )
                     else:
                         resp = getattr(server, method)(req)
@@ -533,17 +803,20 @@ def start_server(
     token_header: str = rpc.DEFAULT_TOKEN_HEADER,
     db_reload_dir: str | None = None,
     db_reload_interval: float = 3600.0,
+    admission=None,
 ):
     """Start the server on a background thread; returns (httpd, actual_port).
     port=0 picks a free port — the reference's own client/server tests use
     exactly this in-process technique (ref: integration/client_server_test.go).
     With ``db_reload_dir``, an hourly worker hot-swaps the advisory DB
-    (ref: listen.go:62-80)."""
+    (ref: listen.go:62-80). ``admission`` takes a resolved
+    :class:`~trivy_tpu.rpc.admission.AdmissionConfig`; None resolves from
+    the environment (admission stays off unless configured)."""
     if cache is None:
         from trivy_tpu.cache import new_cache
 
         cache = new_cache("fs", cache_dir)
-    service = ScanServer(cache, vuln_client=vuln_client)
+    service = ScanServer(cache, vuln_client=vuln_client, admission=admission)
     if db_reload_dir:
         service.reloader = DBReloader(service, db_reload_dir, db_reload_interval)
         service.reloader.start()
@@ -551,6 +824,18 @@ def start_server(
         (host, port), _make_handler(service, token, token_header)
     )
     httpd.service = service  # the drain path and tests need the handle
+    if service.admission is not None:
+        # admission workers stop with the listener even on a bare
+        # httpd.shutdown() (tests, abrupt teardown) — the graceful path
+        # (drain_and_shutdown) already stopped them, and the controller's
+        # shutdown is idempotent
+        _orig_shutdown = httpd.shutdown
+
+        def _shutdown_with_admission():
+            service.admission.shutdown()
+            _orig_shutdown()
+
+        httpd.shutdown = _shutdown_with_admission
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd, httpd.server_address[1]
@@ -564,26 +849,41 @@ DRAIN_TIMEOUT = 30.0
 def drain_and_shutdown(httpd, timeout: float = DRAIN_TIMEOUT,
                        poll: float = 0.05) -> int:
     """Graceful drain: flip /healthz to "draining" and 503 new RPCs (so
-    load balancers and retrying clients move on), wait up to ``timeout``
-    for in-flight requests, then stop the listener. Returns the number of
-    requests still in flight when the listener closed (0 = clean drain)."""
+    load balancers and retrying clients move on), LOUDLY reject
+    queued-but-unstarted admission jobs (their pollers get a terminal
+    ``rejected`` status instead of a stranded 202), wait up to ``timeout``
+    for in-flight requests and running jobs, then stop the listener.
+    Returns the number of requests/jobs still in flight when the listener
+    closed (0 = clean drain)."""
     service = httpd.service
     service.draining = True
     logger.info("draining: refusing new requests, waiting for in-flight")
+    admission = service.admission
+    if admission is not None:
+        admission.reject_queued()
+
+    def _in_flight() -> int:
+        # in-flight HTTP requests (sync scans included) + async jobs on
+        # worker threads; admission.running() would double-count sync
+        # scans, which hold an HTTP request AND a budget slot
+        n = int(service.metrics.in_flight.value())
+        if admission is not None:
+            n += admission.running_jobs()
+        return n
+
     deadline = time.monotonic() + timeout
-    while (
-        service.metrics.in_flight.value() > 0
-        and time.monotonic() < deadline
-    ):
+    while _in_flight() > 0 and time.monotonic() < deadline:
         time.sleep(poll)
-    remaining = int(service.metrics.in_flight.value())
+    remaining = _in_flight()
     if remaining:
         logger.warning(
-            "drain timeout after %.0fs: %d request(s) still in flight",
-            timeout, remaining,
+            "drain timeout after %.0fs: %d request(s)/job(s) still in "
+            "flight", timeout, remaining,
         )
     else:
         logger.info("drained; shutting down")
+    if admission is not None:
+        admission.shutdown()
     httpd.shutdown()
     return remaining
 
@@ -591,11 +891,13 @@ def drain_and_shutdown(httpd, timeout: float = DRAIN_TIMEOUT,
 def serve(host: str, port: int, cache_dir: str | None = None,
           token: str = "", token_header: str = rpc.DEFAULT_TOKEN_HEADER,
           db_repository: str | None = None,
-          drain_timeout: float = DRAIN_TIMEOUT) -> None:
+          drain_timeout: float = DRAIN_TIMEOUT,
+          admission=None) -> None:
     """Blocking server entrypoint for `trivy-tpu server`. SIGTERM (the
     orchestrator's stop signal) triggers a graceful drain: /healthz flips
-    to "draining", in-flight scans finish (bounded by ``drain_timeout``),
-    then the listener closes."""
+    to "draining", queued admission jobs are rejected loudly, in-flight
+    scans finish (bounded by ``drain_timeout``), then the listener
+    closes."""
     import signal
 
     from trivy_tpu.db import load_default_db
@@ -607,6 +909,7 @@ def serve(host: str, port: int, cache_dir: str | None = None,
         host, port, cache_dir=cache_dir, vuln_client=vuln_client,
         token=token, token_header=token_header,
         db_reload_dir=getattr(vuln_client, "db_dir", "") or None,
+        admission=admission,
     )
     stop = threading.Event()
 

@@ -322,6 +322,90 @@ def resolve_tuning(opts: dict | None = None, env: dict | None = None,
     return cfg
 
 
+# -- admission budgets (ROADMAP item 1: multi-tenant serving) ----------------
+
+# HBM proxy for one scan's device-side footprint: the chunk arena is the
+# feed's residency ceiling (slabs x slab bytes — PR 6's RSS bound), so
+# "how many scans fit" is budget / arena footprint. Slab bytes use the
+# pallas-backend batch geometry (1024 rows x 8 KiB chunks); the CPU/XLA
+# fallback slabs are smaller, which only makes this proxy conservative.
+SLAB_PROXY_BYTES = 8 << 20
+# feed.py arena derivation constants, mirrored here so budget resolution
+# never imports the scanner (which initializes jax — a vuln-only server
+# must not touch the accelerator to size its queue)
+_FEED_QUEUE_DEPTH = 2
+_ARENA_MARGIN = 2
+_DEFAULT_STREAMS = 4
+_DEFAULT_INFLIGHT = 2
+
+HBM_BUDGET_ENV = "TRIVY_TPU_HBM_BUDGET_MB"
+DEFAULT_HBM_BUDGET_MB = 1024
+MAX_DERIVED_CONCURRENT = 32
+
+
+def admission_budgets(cfg: TuningConfig | None = None,
+                      env: dict | None = None) -> dict:
+    """Concurrent-scan and queued-bytes budgets for the admission
+    controller, resolved through :class:`TuningConfig` from the topology.
+
+    ``per_scan_bytes`` is the arena footprint one scan pins host+device
+    side (arena slabs x slab bytes — the HBM proxy); the concurrent-scan
+    budget is how many such footprints fit ``TRIVY_TPU_HBM_BUDGET_MB``
+    (default 1024 MB), and the queued-bytes budget caps the host-side
+    queue at one full budget's worth of pending work — queueing more
+    than the device can absorb in one wave only converts overload into
+    memory growth.
+
+    The budget multiplies by device count only when the caller supplies a
+    ``cfg`` with a resolved topology fingerprint: the env-only resolution
+    path (a detection-only scan server) deliberately never probes jax —
+    acquiring accelerators to size a queue would be backwards — so it
+    budgets for one device and the operator raises
+    ``TRIVY_TPU_HBM_BUDGET_MB`` on bigger hosts.
+    """
+    env = os.environ if env is None else env
+    if cfg is None:
+        # autotune_path="" skips record discovery AND the jax topology
+        # probe (resolve_tuning only fingerprints when a record is
+        # consulted) — budget resolution stays accelerator-free
+        cfg = resolve_tuning(autotune_path="", env=env)
+    streams = cfg.feed_streams or _DEFAULT_STREAMS
+    inflight = cfg.inflight or _DEFAULT_INFLIGHT
+    slabs = cfg.arena_slabs or (
+        _FEED_QUEUE_DEPTH + streams * inflight + _ARENA_MARGIN
+    )
+    slabs = max(2, slabs)
+    per_scan_bytes = slabs * SLAB_PROXY_BYTES
+    raw = env.get(HBM_BUDGET_ENV, "")
+    if raw:
+        try:
+            budget_mb = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{HBM_BUDGET_ENV}: not an integer: {raw!r}") from None
+        if budget_mb <= 0:
+            raise ValueError(f"{HBM_BUDGET_ENV}: must be > 0, got {raw!r}")
+    else:
+        budget_mb = DEFAULT_HBM_BUDGET_MB
+    devices = 1
+    if cfg.topology:
+        try:  # "<kind>:<count>:<link>"
+            devices = max(1, int(cfg.topology.split(":")[1]))
+        except (IndexError, ValueError):
+            devices = 1
+    budget_bytes = budget_mb * (1 << 20) * devices
+    max_concurrent = max(
+        1, min(MAX_DERIVED_CONCURRENT, budget_bytes // per_scan_bytes)
+    )
+    return {
+        "max_concurrent": int(max_concurrent),
+        "queued_bytes": int(budget_bytes),
+        "per_scan_bytes": int(per_scan_bytes),
+        "hbm_budget_mb": budget_mb,
+        "devices": devices,
+    }
+
+
 def stream_limit(initial: int) -> int:
     """Online-controller headroom above the configured stream count: the
     controller may grow streams up to 2x the starting point (capped at 16
