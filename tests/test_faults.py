@@ -542,27 +542,13 @@ def test_rpc_retry_honors_retry_after_on_503(monkeypatch):
     monkeypatch.setattr(client_mod, "random", FakeRandom)
     calls = {"n": 0}
 
-    def fake_urlopen(req, timeout=None):
+    def fake_request(url, method, body, headers, timeout):
         calls["n"] += 1
         if calls["n"] <= 2:
-            raise _http_error(503, {"Retry-After": "2.5"})
-        import io
+            return 503, {"Retry-After": "2.5"}, b'{"error": "draining"}'
+        return 200, {}, b'{"ok": true}'
 
-        class R(io.BytesIO):
-            headers = {}
-
-            def __enter__(self):
-                return self
-
-            def __exit__(self, *a):
-                return False
-
-            def read(self):
-                return b'{"ok": true}'
-
-        return R()
-
-    monkeypatch.setattr(client_mod.urllib.request, "urlopen", fake_urlopen)
+    monkeypatch.setattr(client_mod._POOL, "request", fake_request)
     out = client_mod._post("http://x", "/p", {}, "", "T", 1.0)
     assert out == {"ok": True}
     # server-directed minimum plus jitter (backoff/2 here): never shorter
@@ -585,10 +571,10 @@ def test_rpc_retry_uses_full_jitter(monkeypatch):
 
     monkeypatch.setattr(client_mod, "random", FakeRandom)
 
-    def always_refused(req, timeout=None):
+    def always_refused(url, method, body, headers, timeout):
         raise ConnectionRefusedError("nope")
 
-    monkeypatch.setattr(client_mod.urllib.request, "urlopen", always_refused)
+    monkeypatch.setattr(client_mod._POOL, "request", always_refused)
     with pytest.raises(client_mod.RPCError, match="retries exhausted|nope"):
         client_mod._post("http://x", "/p", {}, "", "T", 1.0, retries=4)
     # full jitter: every sleep drawn from U(0, backoff), backoff doubling
@@ -605,11 +591,11 @@ def test_rpc_retry_wall_clock_deadline(monkeypatch):
     ft = _FakeTime()
     monkeypatch.setattr(client_mod, "time", ft)
 
-    def always_refused(req, timeout=None):
+    def always_refused(url, method, body, headers, timeout):
         ft.now += 2.0  # each attempt burns wall clock
         raise ConnectionRefusedError("nope")
 
-    monkeypatch.setattr(client_mod.urllib.request, "urlopen", always_refused)
+    monkeypatch.setattr(client_mod._POOL, "request", always_refused)
     with pytest.raises(client_mod.RPCError, match="deadline"):
         client_mod._post(
             "http://x", "/p", {}, "", "T", 1.0, retries=100, deadline=5.0
@@ -624,24 +610,10 @@ def test_rpc_post_fault_site_retries_to_success(monkeypatch):
     ft = _FakeTime()
     monkeypatch.setattr(client_mod, "time", ft)
 
-    def fake_urlopen(req, timeout=None):
-        import io
+    def fake_request(url, method, body, headers, timeout):
+        return 200, {}, b"{}"
 
-        class R(io.BytesIO):
-            headers = {}
-
-            def __enter__(self):
-                return self
-
-            def __exit__(self, *a):
-                return False
-
-            def read(self):
-                return b"{}"
-
-        return R()
-
-    monkeypatch.setattr(client_mod.urllib.request, "urlopen", fake_urlopen)
+    monkeypatch.setattr(client_mod._POOL, "request", fake_request)
     faults.configure("rpc.post:at=1:times=2:error=conn")
     assert client_mod._post("http://x", "/p", {}, "", "T", 1.0) == {}
     assert len(ft.sleeps) == 2

@@ -1,0 +1,831 @@
+"""Distributed scan fabric (trivy_tpu/fleet/): shard-plan determinism and
+byte balance, fleet-vs-single-host findings parity on fs trees and
+layer-rich images, replica failure → re-dispatch, work-stealing,
+speculative re-dispatch (first result wins), all-dead host fallback,
+merged-trace schema, aggregated progress monotonicity, clean thread
+teardown, and the pooled keep-alive RPC client."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from tests.imagetest import docker_save_tar, tar_bytes
+
+from trivy_tpu import faults, obs
+from trivy_tpu.artifact.image import ImageArchiveArtifact
+from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+from trivy_tpu.cache import new_cache
+from trivy_tpu.fleet import FleetError, parse_fleet
+from trivy_tpu.fleet import plan as fleet_plan
+from trivy_tpu.fleet.coordinator import FleetConfig
+from trivy_tpu.fleet.merge import FleetArtifact
+from trivy_tpu.rpc.admission import resolve_admission
+from trivy_tpu.rpc.server import start_server
+from trivy_tpu.scanner import ScanOptions, Scanner
+from trivy_tpu.scanner.local_driver import LocalDriver
+
+GHP = "ghp_" + "A1b2C3d4E5f6G7h8I9j0K1l2M3n4O5p6Q7r8"[:36]
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+def _assert_no_fleet_threads():
+    left = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith("fleet-worker")
+    ]
+    assert not left, f"leaked fleet worker thread(s): {left}"
+
+
+def make_tree(base, n_dirs=12) -> str:
+    """Secret-bearing fs tree: n_dirs directories with one credential file
+    and one plain file each (sizes skewed so the plan has bytes to
+    balance)."""
+    root = os.path.join(str(base), "tree")
+    for i in range(n_dirs):
+        d = os.path.join(root, f"pkg{i:02d}")
+        os.makedirs(d)
+        with open(os.path.join(d, "cred.txt"), "w") as f:
+            f.write(f"svc{i} token {GHP}\n" * (i + 1))
+        with open(os.path.join(d, "data.py"), "w") as f:
+            f.write(f"print({i})\n" * (20 * (i + 1)))
+    return root
+
+
+def make_image(base, n_layers=6) -> str:
+    """Layer-rich image archive: per-layer secrets, one whiteout, and
+    duplicate paths across layers (the applier's dedup must hold)."""
+    layers = []
+    for i in range(n_layers):
+        files = {
+            f"app{i}/cred.txt": (f"t{i} token {GHP}\n" * (i + 1)).encode(),
+            f"app{i}/notes.md": b"hello world\n" * 30,
+            "shared/config.txt": f"layer {i}\n".encode(),  # later layer wins
+        }
+        if i == n_layers - 1:
+            # whiteout: app0's secret finding must vanish from the merge
+            files["app0/.wh.cred.txt"] = b""
+        layers.append(tar_bytes(files))
+    path = os.path.join(str(base), "img.tar")
+    docker_save_tar(path, layers)
+    return path
+
+
+def _fleet(n, slow=None):
+    """n in-process admission-enabled replicas on loopback; returns
+    (httpds, hosts). ``slow`` maps replica index -> per-scan delay."""
+    httpds, hosts = [], []
+    for i in range(n):
+        cfg = resolve_admission({"max_concurrent_scans": 2})
+        httpd, port = start_server(
+            cache=new_cache("memory", None), admission=cfg
+        )
+        if slow and i in slow:
+            service = httpd.service
+            orig = service.scan
+
+            def wrapped(req, _orig=orig, _d=slow[i], **kw):
+                time.sleep(_d)
+                return _orig(req, **kw)
+
+            service.scan = wrapped
+        httpds.append(httpd)
+        hosts.append(f"127.0.0.1:{port}")
+    return httpds, hosts
+
+
+def _shutdown(httpds):
+    for h in httpds:
+        h.shutdown()
+
+
+def _single_host_fs(root, scanners=("secret",)):
+    cache = new_cache("memory", None)
+    art = LocalFSArtifact(root, cache, ArtifactOption(backend="cpu"))
+    return Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=list(scanners))
+    )
+
+
+def _single_host_image(path, scanners=("secret",)):
+    cache = new_cache("memory", None)
+    art = ImageArchiveArtifact(path, cache, ArtifactOption(backend="cpu"))
+    return Scanner(art, LocalDriver(cache)).scan_artifact(
+        ScanOptions(scanners=list(scanners))
+    )
+
+
+def _fleet_scan(kind, target, hosts, scanners=("secret",), **cfg_kw):
+    cfg_kw.setdefault("speculate", 0.0)
+    cfg = FleetConfig(hosts=list(hosts), **cfg_kw)
+    cache = new_cache("memory", None)
+    so = ScanOptions(scanners=list(scanners))
+    art = FleetArtifact(
+        kind, target, cache, ArtifactOption(backend="cpu"), cfg, so
+    )
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(so)
+    return report, art
+
+
+def _results(report):
+    return [r.to_dict() for r in report.results]
+
+
+# -- config / plan ------------------------------------------------------------
+
+
+class TestParseAndConfig:
+    def test_parse_fleet(self):
+        assert parse_fleet("a:1,b:2, a:1 ,") == ["a:1", "b:2"]
+        assert parse_fleet(["a:1", "b:2"]) == ["a:1", "b:2"]
+        assert parse_fleet(None) == []
+
+    def test_from_opts_requires_hosts(self):
+        with pytest.raises(ValueError):
+            FleetConfig.from_opts({"fleet": []})
+
+    def test_from_opts_tuning_resolution(self):
+        from trivy_tpu.tuning import TuningConfig
+
+        cfg = FleetConfig.from_opts(
+            {"fleet": "h1:1,h2:2"}, tuning=TuningConfig(fleet_inflight=3)
+        )
+        assert cfg.inflight == 3  # tuning layer supplies the default
+        cfg = FleetConfig.from_opts(
+            {"fleet": "h1:1", "fleet_inflight": 5},
+            tuning=TuningConfig(fleet_inflight=3),
+        )
+        assert cfg.inflight == 5  # explicit CLI wins
+
+    def test_fleet_inflight_resolves_through_tuning_env(self):
+        from trivy_tpu.tuning import resolve_tuning
+
+        cfg = resolve_tuning(
+            opts={}, env={"TRIVY_TPU_FLEET_INFLIGHT": "4"}, autotune_path=""
+        )
+        assert cfg.fleet_inflight == 4
+        assert cfg.source["fleet_inflight"] == "env"
+
+
+class TestFsPlan:
+    def test_deterministic(self, tmp_path):
+        root = make_tree(tmp_path)
+        opt = ArtifactOption(backend="cpu")
+        so = ScanOptions(scanners=["secret"])
+        a, tb_a, tf_a = fleet_plan.plan_fs_shards(root, opt, so, 4)
+        b, tb_b, tf_b = fleet_plan.plan_fs_shards(root, opt, so, 4)
+        assert [s.wire for s in a] == [s.wire for s in b]
+        assert (tb_a, tf_a) == (tb_b, tf_b)
+
+    def test_byte_balance_and_coverage(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=16)
+        opt = ArtifactOption(backend="cpu")
+        so = ScanOptions(scanners=["secret"])
+        shards, total_bytes, total_files = fleet_plan.plan_fs_shards(
+            root, opt, so, 4
+        )
+        assert len(shards) == 4
+        all_paths = [p for s in shards for p in s.wire["Paths"]]
+        assert len(all_paths) == total_files == len(set(all_paths))
+        assert sum(s.nbytes for s in shards) == total_bytes
+        loads = sorted(s.nbytes for s in shards)
+        # LPT over 16 directory units: the heaviest shard stays within 2x
+        # of the lightest even on this skewed tree
+        assert loads[-1] <= 2 * max(1, loads[0])
+        # planner emits largest-first (the dispatch-queue order)
+        assert [s.nbytes for s in shards] == sorted(
+            (s.nbytes for s in shards), reverse=True
+        )
+
+    def test_directories_stay_atomic(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=8)
+        shards, _, _ = fleet_plan.plan_fs_shards(
+            root, ArtifactOption(), ScanOptions(), 8
+        )
+        owner = {}
+        for s in shards:
+            for p in s.wire["Paths"]:
+                d = p.rsplit("/", 1)[0]
+                assert owner.setdefault(d, s.index) == s.index, (
+                    f"directory {d} split across shards"
+                )
+
+    def test_helm_chart_subtree_atomic(self, tmp_path):
+        root = os.path.join(str(tmp_path), "tree")
+        chart = os.path.join(root, "deploy", "mychart")
+        os.makedirs(os.path.join(chart, "templates"))
+        with open(os.path.join(chart, "Chart.yaml"), "w") as f:
+            f.write("apiVersion: v2\nname: mychart\nversion: 1.0.0\n")
+        with open(os.path.join(chart, "values.yaml"), "w") as f:
+            f.write("x: 1\n" * 200)
+        with open(os.path.join(chart, "templates", "dep.yaml"), "w") as f:
+            f.write("kind: Deployment\n" * 100)
+        for i in range(6):
+            d = os.path.join(root, f"other{i}")
+            os.makedirs(d)
+            with open(os.path.join(d, "f.txt"), "w") as f:
+                f.write("data\n" * 100)
+        shards, _, _ = fleet_plan.plan_fs_shards(
+            root, ArtifactOption(), ScanOptions(), 8
+        )
+        owners = {
+            s.index
+            for s in shards
+            for p in s.wire["Paths"]
+            if p.startswith("deploy/mychart/")
+        }
+        assert len(owners) == 1, "helm chart subtree split across shards"
+
+
+class TestImagePlan:
+    def test_covers_exactly_missing_layers(self, tmp_path):
+        path = make_image(tmp_path, n_layers=5)
+        cache = new_cache("memory", None)
+        opt = ArtifactOption(backend="cpu")
+        so = ScanOptions(scanners=["secret"])
+        art = ImageArchiveArtifact(path, cache, opt)
+        plan = fleet_plan.plan_image_shards(art, cache, so)
+        assert len(plan.shards) == 5
+        assert plan.config_missing
+        planned = {s.wire["BlobID"] for s in plan.shards}
+        assert planned == set(plan.blob_ids[:-1])
+        # warm one layer into the cache: it must drop out of the plan
+        # ("cached layers are never shipped")
+        archive = art._open_source()
+        try:
+            lp = art.layer_plan(archive)
+        finally:
+            archive.close()
+        warm = lp["layer_keys"][2]
+        blob = fleet_plan.execute_shard(
+            next(s for s in plan.shards if s.wire["BlobID"] == warm).wire,
+            cache,
+        )
+        assert blob[0]["BlobID"] == warm
+        plan2 = fleet_plan.plan_image_shards(art, cache, so)
+        assert len(plan2.shards) == 4
+        assert warm not in {s.wire["BlobID"] for s in plan2.shards}
+
+    def test_unknown_shard_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown shard kind"):
+            fleet_plan.execute_shard({"Kind": "nope"}, new_cache("memory", None))
+
+    def test_analysis_wire_ships_config_and_registry_options(self, tmp_path):
+        # findings parity depends on the replica reconstructing the SAME
+        # analyzer set: custom secret rules, registry credentials, and
+        # the parallel knob must all ride the shard wire
+        cfg = os.path.join(str(tmp_path), "rules.yaml")
+        with open(cfg, "w") as f:
+            f.write("rules: []\n")
+        opt = ArtifactOption(
+            backend="cpu", secret_config_path=cfg, parallel=3,
+            insecure_registry=True, registry_username="u",
+            registry_password="p", platform="linux/amd64",
+        )
+        wire = fleet_plan._analysis_wire(opt, ScanOptions(scanners=["secret"]))
+        assert wire["SecretConfig"] == cfg
+        assert wire["Parallel"] == 3
+        assert wire["Registry"] == {
+            "Insecure": True, "Username": "u", "Password": "p",
+            "Platform": "linux/amd64",
+        }
+        rebuilt = fleet_plan.shard_artifact_option({"Kind": "fs", **wire})
+        assert rebuilt.secret_config_path == cfg
+        assert rebuilt.parallel == 3
+        assert rebuilt.registry_username == "u"
+        assert rebuilt.registry_password == "p"
+        assert rebuilt.insecure_registry is True
+
+    def test_missing_secret_config_on_replica_fails_loudly(self):
+        # a replica that cannot see the coordinator's custom ruleset must
+        # fail the shard, never silently scan with default rules
+        with pytest.raises(FileNotFoundError, match="secret config"):
+            fleet_plan.shard_artifact_option(
+                {"Kind": "fs", "Scanners": ["secret"],
+                 "SecretConfig": "/nonexistent/rules.yaml"}
+            )
+
+    def test_missing_fs_root_fails_loudly(self):
+        # a replica without the coordinator's filesystem must fail the
+        # shard, not absorb every path as a TOCTOU skip and return an
+        # empty (silently wrong) blob
+        with pytest.raises(FileNotFoundError, match="does not exist"):
+            fleet_plan.execute_shard(
+                {"Kind": "fs", "Root": "/nonexistent/fleet/root",
+                 "Paths": ["a.txt"], "Scanners": ["secret"]},
+                new_cache("memory", None),
+            )
+
+
+# -- parity -------------------------------------------------------------------
+
+
+class TestParity:
+    def test_fs_parity_and_merged_observability(self, tmp_path):
+        root = make_tree(tmp_path)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2)
+        try:
+            with obs.scan_context(name="fleet-test", enabled=True) as ctx:
+                report, art = _fleet_scan("fs", root, hosts)
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        assert report.results, "parity against an empty report proves nothing"
+        assert not report.degraded
+        assert report.artifact_name == single.artifact_name
+        stats = art.stats()
+        assert stats["shards"] >= 4
+        assert sum(stats["replica_shards"].values()) == stats["shards"]
+        # every replica did real work
+        assert all(v > 0 for v in stats["replica_shards"].values())
+        # merged-trace schema: ONE trace id across every joined shard doc,
+        # and the Chrome export renders replicas as distinct extra pids
+        assert ctx.remote, "no shard Trace docs joined the coordinator"
+        assert {d.get("trace_id") for d in ctx.remote} == {ctx.trace_id}
+        from trivy_tpu.obs import export as obs_export
+
+        pids = {e["pid"] for e in obs_export.chrome_trace_events(ctx)}
+        assert 1 in pids and len(pids - {1}) >= len(hosts)
+        # aggregated progress covered the whole plan
+        snap = ctx.progress().snapshot()
+        assert snap["bytes_scanned"] == snap["bytes_walked"] > 0
+        _assert_no_fleet_threads()
+
+    def test_image_parity_layer_rich(self, tmp_path):
+        path = make_image(tmp_path, n_layers=6)
+        single = _single_host_image(path)
+        httpds, hosts = _fleet(2)
+        try:
+            report, art = _fleet_scan("image", path, hosts)
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        assert report.results
+        assert report.metadata == single.metadata  # DiffIDs/ImageID identical
+        assert not report.degraded
+        # whiteout semantics survived the merge: app0's secret is gone
+        assert not any("app0/cred.txt" in r.target for r in report.results)
+        _assert_no_fleet_threads()
+
+    def test_fs_parity_secret_and_license(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=6)
+        lic = os.path.join(root, "pkg00", "LICENSE")
+        with open(lic, "w") as f:
+            f.write(
+                "Permission is hereby granted, free of charge, to any "
+                "person obtaining a copy of this software and associated "
+                "documentation files (the \"Software\"), to deal in the "
+                "Software without restriction, including without "
+                "limitation the rights to use, copy, modify, merge, "
+                "publish, distribute, sublicense, and/or sell copies.\n"
+            )
+        single = _single_host_fs(root, scanners=("secret", "license"))
+        httpds, hosts = _fleet(2)
+        try:
+            report, _ = _fleet_scan(
+                "fs", root, hosts, scanners=("secret", "license")
+            )
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+
+
+# -- failure ladder -----------------------------------------------------------
+
+
+class TestFailureLadder:
+    def test_dead_replica_redispatch_parity(self, tmp_path):
+        """Replica 0 unreachable from the first dispatch: every shard must
+        re-dispatch to the survivor with findings parity and NO degraded
+        flag (the fault site proves the fleet.dispatch rung)."""
+        root = make_tree(tmp_path, n_dirs=8)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2)
+        try:
+            faults.configure(f"fleet.dispatch@{hosts[0]}:times=-1")
+            report, art = _fleet_scan("fs", root, hosts)
+        finally:
+            faults.clear()
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        assert not report.degraded
+        stats = art.stats()
+        assert stats["redispatches"] >= 1
+        assert stats["replica_shards"][hosts[0]] == 0
+        assert stats["replica_shards"][hosts[1]] == stats["shards"]
+        _assert_no_fleet_threads()
+
+    def test_replica_failure_mid_scan_redispatch(self, tmp_path):
+        """Replica 0 completes its first shard then dies (every later scan
+        raises): in-flight and queued shards must finish elsewhere with
+        parity and no degraded flag."""
+        root = make_tree(tmp_path, n_dirs=10)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2)
+        service = httpds[0].service
+        orig = service.scan
+        calls = [0]
+
+        def dying(req, **kw):
+            calls[0] += 1
+            if calls[0] > 1:
+                raise RuntimeError("replica killed mid-scan")
+            return orig(req, **kw)
+
+        service.scan = dying
+        try:
+            report, art = _fleet_scan("fs", root, hosts)
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        assert not report.degraded
+        stats = art.stats()
+        assert stats["redispatches"] >= 1
+        assert stats["local_fallback"] == 0
+        assert stats["replica_shards"][hosts[1]] >= stats["shards"] - 1
+        _assert_no_fleet_threads()
+
+    def test_result_fault_redispatches_one_shard(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=6)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2)
+        try:
+            faults.configure("fleet.result:at=1")  # first result fold fails
+            report, art = _fleet_scan("fs", root, hosts)
+        finally:
+            faults.clear()
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        assert not report.degraded
+        assert art.stats()["redispatches"] >= 1
+        _assert_no_fleet_threads()
+
+    def test_all_dead_host_fallback_parity(self, tmp_path):
+        """Every replica dead: the scan completes locally (parity oracle)
+        with the degraded flag raised."""
+        root = make_tree(tmp_path, n_dirs=6)
+        single = _single_host_fs(root)
+        report, art = _fleet_scan(
+            "fs", root, ["127.0.0.1:9", "127.0.0.1:10"],
+            rpc_retries=0, rpc_deadline=2.0,
+        )
+        assert _results(report) == _results(single)
+        assert report.degraded
+        stats = art.stats()
+        assert stats["local_fallback"] == stats["shards"] > 0
+        _assert_no_fleet_threads()
+
+    def test_all_dead_no_host_fallback_raises(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=4)
+        cfg = FleetConfig(
+            hosts=["127.0.0.1:9"], speculate=0.0, host_fallback=False,
+            rpc_retries=0, rpc_deadline=2.0,
+        )
+        cache = new_cache("memory", None)
+        so = ScanOptions(scanners=["secret"])
+        art = FleetArtifact(
+            "fs", root, cache, ArtifactOption(backend="cpu"), cfg, so
+        )
+        with pytest.raises(FleetError, match="no-host-fallback"):
+            art.inspect()
+        _assert_no_fleet_threads()
+
+
+# -- stealing / speculation ---------------------------------------------------
+
+
+class TestStealAndSpeculate:
+    def test_work_steal_skewed_fleet(self, tmp_path):
+        """Replica 0 is slow: replica 1 drains its own queue, then steals
+        replica 0's queued shards — parity holds and the steal counter
+        proves the handoff."""
+        root = make_tree(tmp_path, n_dirs=12)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2, slow={0: 0.35})
+        try:
+            report, art = _fleet_scan(
+                "fs", root, hosts, inflight=1, shards_per_replica=4,
+            )
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        stats = art.stats()
+        assert stats["steals"] >= 1
+        # the fast replica carried more of the fleet than the slow one
+        assert (
+            stats["replica_shards"][hosts[1]]
+            > stats["replica_shards"][hosts[0]]
+        )
+        _assert_no_fleet_threads()
+
+    def test_steal_fault_requeues_not_loses(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=8)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2, slow={0: 0.3})
+        try:
+            faults.configure(f"fleet.steal@{hosts[1]}:at=1")
+            report, art = _fleet_scan(
+                "fs", root, hosts, inflight=1, shards_per_replica=4,
+            )
+        finally:
+            faults.clear()
+            _shutdown(httpds)
+        assert _results(report) == _results(single)  # nothing lost
+        _assert_no_fleet_threads()
+
+    def test_speculative_redispatch_first_result_wins(self, tmp_path):
+        """One replica is a straggler: its in-flight shard re-dispatches
+        speculatively to the idle replica, the fast result wins, and the
+        loser's poll is cancelled."""
+        root = make_tree(tmp_path, n_dirs=4)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2, slow={0: 2.5})
+        try:
+            t0 = time.monotonic()
+            report, art = _fleet_scan(
+                "fs", root, hosts, inflight=1, shards_per_replica=1,
+                speculate=1.0, speculate_floor_s=0.3,
+            )
+            wall = time.monotonic() - t0
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        stats = art.stats()
+        assert stats["speculative"] >= 1
+        assert stats["cancelled"] >= 1
+        # first-result-wins: the scan must NOT have waited out the 2.5 s
+        # straggler for every one of its shards
+        assert wall < 2.5 + 2.0
+        _assert_no_fleet_threads()
+
+
+# -- progress -----------------------------------------------------------------
+
+
+class TestProgress:
+    def test_aggregated_progress_monotonic(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=10)
+        httpds, hosts = _fleet(2, slow={0: 0.05, 1: 0.05})
+        ratios = []
+        stop = threading.Event()
+        try:
+            with obs.scan_context(name="fleet-progress") as ctx:
+                def sample():
+                    while not stop.wait(0.02):
+                        prog = ctx.progress_peek()
+                        if prog is not None:
+                            ratios.append(prog.ratio())
+
+                t = threading.Thread(target=sample, daemon=True)
+                t.start()
+                report, _ = _fleet_scan("fs", root, hosts)
+                stop.set()
+                t.join(timeout=5)
+                final = ctx.progress().snapshot()
+        finally:
+            stop.set()
+            _shutdown(httpds)
+        assert report.results
+        assert all(b >= a for a, b in zip(ratios, ratios[1:])), (
+            "aggregated fleet progress went backwards"
+        )
+        assert final["bytes_scanned"] == final["bytes_walked"] > 0
+        assert final["walk_complete"]
+
+
+# -- replica shard API --------------------------------------------------------
+
+
+class TestShardAPI:
+    def test_sync_shard_scan_without_admission(self, tmp_path):
+        """A replica running WITHOUT admission control has no job API; the
+        coordinator falls back to synchronous shard scans transparently."""
+        root = make_tree(tmp_path, n_dirs=4)
+        single = _single_host_fs(root)
+        httpd, port = start_server(cache=new_cache("memory", None))
+        try:
+            report, art = _fleet_scan("fs", root, [f"127.0.0.1:{port}"])
+        finally:
+            httpd.shutdown()
+        assert _results(report) == _results(single)
+        assert art.coordinator._sync_only == [True]
+        _assert_no_fleet_threads()
+
+    def test_shard_health_propagates_skipped_files(self, tmp_path):
+        """A file that vanishes between plan and execution surfaces as
+        SkippedFiles in the merged report, fed by the shard Health block."""
+        root = make_tree(tmp_path, n_dirs=4)
+        httpds, hosts = _fleet(1)
+        try:
+            shards, _, _ = fleet_plan.plan_fs_shards(
+                root, ArtifactOption(backend="cpu"),
+                ScanOptions(scanners=["secret"]), 2,
+            )
+            os.unlink(os.path.join(root, "pkg00", "data.py"))
+            cfg = FleetConfig(hosts=hosts, speculate=0.0)
+            cache = new_cache("memory", None)
+            so = ScanOptions(scanners=["secret"])
+            art = FleetArtifact(
+                "fs", root, cache, ArtifactOption(backend="cpu"), cfg, so
+            )
+            # plan inside inspect() re-walks (file already gone) — so drive
+            # the coordinator directly with the stale plan instead
+            coord_report = None
+            with obs.scan_context(name="stale-plan") as ctx:
+                from trivy_tpu.fleet.coordinator import FleetCoordinator
+
+                coord = FleetCoordinator(cfg, so, local_cache=cache)
+                coord.run(shards)
+                health = ctx.health_snapshot()
+            assert health.get("walk.skipped", 0) >= 1
+        finally:
+            _shutdown(httpds)
+        _assert_no_fleet_threads()
+
+
+class TestBreakerProbe:
+    def test_try_probe_claims_only_own_slot(self):
+        from trivy_tpu.parallel.mesh import CircuitBreaker
+
+        clock = [0.0]
+        br = CircuitBreaker(
+            2, threshold=1, probe_backoff=1.0, clock=lambda: clock[0],
+            labels=["fleet:a", "fleet:b"],
+        )
+        assert br.try_probe(0)  # closed → dispatchable
+        br.record_failure(0)  # threshold 1 → opens
+        br.record_failure(1)
+        assert not br.try_probe(0)  # open, probe not yet due
+        clock[0] = 1.5
+        assert br.try_probe(0)  # probe due: claimed
+        assert not br.try_probe(0)  # one probe at a time
+        # replica 1's slot was never touched by replica 0's claims
+        assert br.try_probe(1)
+        br.record_success(0)
+        assert br.try_probe(0)  # closed again
+
+
+# -- pooled keep-alive client -------------------------------------------------
+
+
+class TestConnectionPool:
+    def test_keepalive_reuse_across_requests(self):
+        from trivy_tpu.rpc import client as rpc_client
+
+        httpd, port = start_server(cache=new_cache("memory", None))
+        base = f"http://127.0.0.1:{port}"
+        try:
+            s0 = rpc_client.pool_stats()
+            for _ in range(3):
+                _, doc, _ = rpc_client._get_json(
+                    base + "/healthz", "", "Trivy-Token", 5.0, "healthz"
+                )
+                assert doc["Status"] == "ok"
+            s1 = rpc_client.pool_stats()
+        finally:
+            httpd.shutdown()
+        assert s1["created"] - s0["created"] == 1
+        assert s1["reused"] - s0["reused"] >= 2
+
+    def test_keepalive_survives_shed_reply(self):
+        """PR 10 made the server drain unread bodies on early replies so
+        keep-alive survives a shed; this is the client half: the pooled
+        connection that carried a 429/503 shed must be REUSED for the next
+        (successful) request, not torn down."""
+        from trivy_tpu.rpc import client as rpc_client
+        from trivy_tpu.rpc.client import RemoteDriver, RPCError
+
+        cfg = resolve_admission({"max_concurrent_scans": 1})
+        httpd, port = start_server(
+            cache=new_cache("memory", None), admission=cfg
+        )
+        base = f"http://127.0.0.1:{port}"
+        service = httpd.service
+        orig = service.scan
+        release = threading.Event()
+
+        def slow(req, **kw):
+            release.wait(10.0)
+            return orig(req, **kw)
+
+        service.scan = slow
+        try:
+            # occupy the 1-scan budget
+            bg = threading.Thread(
+                target=lambda: RemoteDriver(base).scan(
+                    "bg", "a", [], ScanOptions(scanners=["vuln"])
+                ),
+                daemon=True,
+            )
+            bg.start()
+            deadline = time.monotonic() + 5
+            while service.admission.running() == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            s0 = rpc_client.pool_stats()
+            shed_driver = RemoteDriver(base, retries=0)
+            with pytest.raises(RPCError, match="503"):
+                shed_driver.scan("shed", "b", [], ScanOptions(scanners=["vuln"]))
+            release.set()
+            bg.join(timeout=10)
+            # the next request rides the SAME pooled connection
+            resp = shed_driver.scan("ok", "c", [], ScanOptions(scanners=["vuln"]))
+            s1 = rpc_client.pool_stats()
+            assert resp is not None
+        finally:
+            release.set()
+            httpd.shutdown()
+        # exactly ONE connection serves the shed attempt; had the 503 torn
+        # it down, the follow-up scan would have opened a second
+        assert s1["created"] - s0["created"] == 1, (
+            "shed reply tore down the keep-alive connection"
+        )
+        assert s1["reused"] - s0["reused"] >= 1
+
+    def test_proxy_env_routes_through_urllib(self, monkeypatch):
+        """http_proxy environments must keep the old urlopen semantics:
+        the pool must not open a DIRECT connection that silently bypasses
+        a mandatory egress proxy."""
+        import urllib.request
+
+        from trivy_tpu.rpc import client as rpc_client
+
+        monkeypatch.setenv("http_proxy", "http://127.0.0.1:1")  # dead proxy
+        monkeypatch.delenv("no_proxy", raising=False)
+        s0 = rpc_client.pool_stats()
+        try:
+            with pytest.raises(rpc_client.RPCError):
+                rpc_client._get_json(
+                    "http://fleet-proxy-test.invalid/healthz", "",
+                    "Trivy-Token", 2.0, "healthz",
+                )
+        finally:
+            # urlopen builds its module-global opener on first use and
+            # BAKES the proxy env into it — drop it so later tests (and
+            # their plain urlopen probes) don't route through the dead
+            # proxy after the env is restored
+            urllib.request._opener = None
+        s1 = rpc_client.pool_stats()
+        # the failure came from the urllib/proxy path, not a pooled
+        # direct connection
+        assert s1["created"] == s0["created"]
+
+    def test_stale_pooled_connection_retries_fresh(self):
+        """A server that closes an idle keep-alive socket between requests
+        (restart, LB idle timeout) leaves a stale pooled connection; the
+        next request must transparently retry on a fresh connection
+        instead of surfacing the dead socket."""
+        import socket
+
+        from trivy_tpu.rpc import client as rpc_client
+
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(2)
+        port = lsock.getsockname()[1]
+        body = b'{"Status": "ok"}'
+        wire = (
+            b"HTTP/1.1 200 OK\r\nContent-Type: application/json\r\n"
+            b"Content-Length: %d\r\n\r\n%s" % (len(body), body)
+        )
+
+        def serve():
+            # each accepted connection serves ONE request, then the server
+            # closes it WITHOUT Connection: close — the client pools it
+            # and discovers the close only on reuse
+            for _ in range(2):
+                c, _ = lsock.accept()
+                c.recv(65536)
+                c.sendall(wire)
+                c.shutdown(socket.SHUT_RDWR)
+                c.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            s0 = rpc_client.pool_stats()
+            _, doc, _ = rpc_client._get_json(
+                base + "/healthz", "", "Trivy-Token", 5.0, "healthz"
+            )
+            assert doc["Status"] == "ok"
+            time.sleep(0.05)  # let the server-side close land
+            _, doc, _ = rpc_client._get_json(
+                base + "/healthz", "", "Trivy-Token", 5.0, "healthz"
+            )
+            assert doc["Status"] == "ok"
+            s1 = rpc_client.pool_stats()
+        finally:
+            lsock.close()
+            t.join(timeout=5)
+        # second request found the pooled socket dead, invalidated it, and
+        # retried on a fresh connection — no error surfaced to the caller
+        assert s1["invalidated"] - s0["invalidated"] >= 1
+        assert s1["created"] - s0["created"] == 2

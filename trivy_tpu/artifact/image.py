@@ -136,6 +136,22 @@ class _ImageArchive:
                 return f
         raise KeyError(f"layer not found: {member}")
 
+    def layer_size(self, index: int) -> int:
+        """Stored byte size of layer ``index``'s tar — the balance/steal
+        weight the fleet shard planner partitions by."""
+        member = self._layer_paths[index]
+        if self._tar is None:
+            try:
+                return os.path.getsize(os.path.join(self.path, member))
+            except OSError:
+                return 0
+        for cand in (member, f"./{member}"):
+            try:
+                return self._tar.getmember(cand).size
+            except KeyError:
+                continue
+        return 0
+
     def layer_history(self) -> list[dict]:
         """History entries aligned to diff_ids (empty_layer entries skipped)."""
         out = []
@@ -246,35 +262,55 @@ class ImageArchiveArtifact:
 
     # -- inspect -------------------------------------------------------------
 
+    def layer_plan(self, archive) -> dict:
+        """Cache-key plan for one image: per-layer blob keys, the config
+        key, and the artifact key — the single computation both
+        :meth:`inspect` and the fleet shard planner
+        (:func:`trivy_tpu.fleet.plan.plan_image_shards`) read, so a fleet
+        scan's shards land under exactly the keys a single-host scan
+        would store."""
+        versions = self.group.versions()
+        hooks = self.handlers.versions()
+        diff_ids = archive.diff_ids
+
+        def key(base: str) -> str:
+            return calc_key(
+                base,
+                analyzer_versions=versions,
+                hook_versions=hooks,
+                skip_files=self.option.skip_files,
+                skip_dirs=self.option.skip_dirs,
+            )
+
+        base_layers = _base_layer_indices(archive.config.get("history", []))
+        # the per-layer analyzer set is part of the key: a base layer is
+        # analyzed without the secret analyzer, and that blob must never
+        # satisfy a scan where the same diff-ID is NOT a base layer
+        # (ref: image.go calcKeys appends the per-layer disabled list)
+        layer_keys = [
+            key(d + ("/secret-skipped" if i in base_layers else ""))
+            for i, d in enumerate(diff_ids)
+        ]
+        return {
+            "diff_ids": diff_ids,
+            "history": archive.layer_history(),
+            "base_layers": base_layers,
+            "layer_keys": layer_keys,
+            "config_key": key(archive.image_id + "/config"),
+            "artifact_key": key(archive.image_id),
+        }
+
     def inspect(self) -> ArtifactReference:
         archive = self._open_source()
         try:
-            versions = self.group.versions()
-            hooks = self.handlers.versions()
-            diff_ids = archive.diff_ids
-            history = archive.layer_history()
-
-            def key(base: str) -> str:
-                return calc_key(
-                    base,
-                    analyzer_versions=versions,
-                    hook_versions=hooks,
-                    skip_files=self.option.skip_files,
-                    skip_dirs=self.option.skip_dirs,
-                )
-
-            base_layers = _base_layer_indices(archive.config.get("history", []))
-            # the per-layer analyzer set is part of the key: a base layer is
-            # analyzed without the secret analyzer, and that blob must never
-            # satisfy a scan where the same diff-ID is NOT a base layer
-            # (ref: image.go calcKeys appends the per-layer disabled list)
-            layer_keys = [
-                key(d + ("/secret-skipped" if i in base_layers else ""))
-                for i, d in enumerate(diff_ids)
-            ]
-            config_key = key(archive.image_id + "/config")
+            plan = self.layer_plan(archive)
+            diff_ids = plan["diff_ids"]
+            history = plan["history"]
+            base_layers = plan["base_layers"]
+            layer_keys = plan["layer_keys"]
+            config_key = plan["config_key"]
             blob_ids = layer_keys + [config_key]
-            artifact_key = key(archive.image_id)
+            artifact_key = plan["artifact_key"]
 
             _, missing = self.cache.missing_blobs(artifact_key, blob_ids)
             missing_set = set(missing)

@@ -386,18 +386,53 @@ def server_client_flags() -> FlagGroup:
     )
 
 
+def fleet_flags() -> FlagGroup:
+    """Distributed scan fabric (README "Distributed scanning"): scatter
+    one giant artifact across server replicas and merge the results."""
+    return FlagGroup(
+        "fleet",
+        [
+            Flag("fleet", default=None, is_list=True,
+                 config_name="fleet.replicas",
+                 help="comma-separated replica addresses (host:port) for a "
+                      "scatter-gather distributed scan: the artifact splits "
+                      "at natural boundaries (image layers, byte-balanced "
+                      "walk partitions) and shards fan out as async jobs, "
+                      "with work-stealing, speculative re-dispatch, and "
+                      "per-replica circuit breakers"),
+            Flag("fleet-inflight", default=0, value_type=int,
+                 config_name="fleet.inflight",
+                 help="async shard jobs in flight per replica (0 = auto: "
+                      "2; resolves through TuningConfig like every other "
+                      "perf knob — env TRIVY_TPU_FLEET_INFLIGHT)"),
+            Flag("fleet-shards-per-replica", default=0, value_type=int,
+                 config_name="fleet.shards-per-replica",
+                 help="fs-tree overpartition factor: target shard count is "
+                      "replicas x this (0 = auto: 4); more shards = finer "
+                      "steal grain, more per-shard RPC overhead"),
+            Flag("fleet-speculate", default=None, value_type=float,
+                 config_name="fleet.speculate",
+                 help="straggler multiplier: an in-flight shard running "
+                      "past this x the median shard wall time is "
+                      "speculatively re-dispatched to an idle replica, "
+                      "first result wins (default 2.0; 0 disables)"),
+        ],
+    )
+
+
 _TARGET_GROUPS = {
     "fs": [global_flags, scan_flags, report_flags, secret_flags, license_flags,
-           misconf_flags, db_flags, server_client_flags, tuning_flags],
+           misconf_flags, db_flags, server_client_flags, fleet_flags,
+           tuning_flags],
     "rootfs": [global_flags, scan_flags, report_flags, secret_flags,
                license_flags, misconf_flags, db_flags, server_client_flags,
-               tuning_flags],
+               fleet_flags, tuning_flags],
     "repo": [global_flags, scan_flags, report_flags, secret_flags,
              license_flags, misconf_flags, db_flags, server_client_flags,
-             tuning_flags],
+             fleet_flags, tuning_flags],
     "image": [global_flags, scan_flags, report_flags, secret_flags,
               license_flags, misconf_flags, db_flags, server_client_flags,
-              image_flags, tuning_flags],
+              image_flags, fleet_flags, tuning_flags],
     "vm": [global_flags, scan_flags, report_flags, secret_flags,
            license_flags, misconf_flags, db_flags, server_client_flags,
            tuning_flags],

@@ -46,6 +46,7 @@ def _resolve_tuning(opts):
         "secret_arena_slabs": opts.get("secret_arena_slabs"),
         "secret_bucket_rungs": opts.get("secret_bucket_rungs"),
         "parallel": opts.get("parallel"),
+        "fleet_inflight": opts.get("fleet_inflight"),
         "tuning_file": opts.get("tuning_file"),
         # the store_true default (False) must not shadow the env layer:
         # only an EXPLICIT --tune is a CLI-level decision
@@ -405,6 +406,14 @@ def _run_fs_like(command: str, ns, opts) -> int:
             return 1
 
     server = opts.get("server")
+    if opts.get("fleet"):
+        # fleet mode: the artifact splits into shards that fan out across
+        # the replica set; blobs merge back through the standard local
+        # driver (README "Distributed scanning")
+        if server:
+            logger.error("--fleet and --server are mutually exclusive")
+            return 2
+        return _run_fleet("fs", target, ns, opts, art_opt)
     if server:
         # client mode: analysis is local, blobs ship to the SERVER's cache
         # and detection runs there (ref: run.go:348-355 split)
@@ -423,6 +432,35 @@ def _run_fs_like(command: str, ns, opts) -> int:
     return _emit(report, ns, opts)
 
 
+def _run_fleet(kind: str, target: str, ns, opts, art_opt) -> int:
+    """Scatter-gather scan across a ``--fleet`` replica set: shard plan →
+    async fan-out with work-stealing/speculation/breakers → blobs merged
+    into the local cache → the ordinary LocalDriver detection + report
+    path (findings byte-identical to a single-host scan)."""
+    from trivy_tpu.fleet import FleetError
+    from trivy_tpu.fleet.coordinator import FleetConfig
+    from trivy_tpu.fleet.merge import FleetArtifact
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    tuning = (art_opt.analyzer_extra or {}).get("tuning")
+    try:
+        fleet_cfg = FleetConfig.from_opts(opts, tuning=tuning)
+    except ValueError as e:
+        logger.error("%s", e)
+        return 2
+    cache = _make_cache(opts)
+    artifact = FleetArtifact(
+        kind, target, cache, art_opt, fleet_cfg, _scan_options(opts)
+    )
+    driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    try:
+        report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
+    except FleetError as e:
+        logger.error("fleet scan failed: %s", e)
+        return 1
+    return _emit(report, ns, opts)
+
+
 def _run_image(ns, opts) -> int:
     from trivy_tpu.artifact.image import ImageArchiveArtifact, new_image_artifact
     from trivy_tpu.scanner.local_driver import LocalDriver
@@ -431,6 +469,12 @@ def _run_image(ns, opts) -> int:
     if not target:
         logger.error("specify an image archive path (positional or --input)")
         return 1
+    if opts.get("fleet"):
+        if opts.get("server"):
+            logger.error("--fleet and --server are mutually exclusive")
+            return 2
+        return _run_fleet("image", target, ns, opts,
+                          _artifact_option(ns, opts))
     cache = _make_cache(opts)
     artifact = new_image_artifact(target, cache, _artifact_option(ns, opts))
     driver = LocalDriver(cache, vuln_client=_vuln_client(opts))

@@ -23,6 +23,13 @@ Instrumented sites (key in parentheses):
 - ``admission.dequeue`` (tenant name) — job handoff from queue to worker
   (a fault here must fail that one job terminally, never wedge the queue)
 - ``job.result.fetch`` (job id) — async job result lookup
+- ``fleet.dispatch`` (replica address) — coordinator-side shard dispatch
+  to one fleet replica (a fault here must re-dispatch to a survivor via
+  the per-replica breaker, never fail the scan)
+- ``fleet.steal`` (stealing replica address) — work-steal handoff of a
+  queued shard (a fault here must requeue the shard, never lose it)
+- ``fleet.result`` (shard index) — coordinator-side shard result fold (a
+  fault here counts as a failed attempt and re-dispatches that one shard)
 
 Spec grammar (``--fault-inject`` / ``TRIVY_TPU_FAULT_INJECT``), clauses
 comma-separated::

@@ -1,0 +1,56 @@
+"""Distributed scan fabric (ROADMAP item 5): scatter-gather scanning of
+one giant artifact across N server replicas.
+
+Items 1–2 scale *many small scans* on one host; a single multi-GB image or
+monorepo stays pinned at one host's link ceiling no matter how well the
+feed is tuned — the only way past it is more replicas, each with its own
+accelerator and feed path. This package is the coordinator side of that:
+
+- :mod:`trivy_tpu.fleet.plan` — the **shard planner**: split an artifact
+  at natural boundaries (image layers; byte-balanced, directory-atomic
+  walk partitions for fs trees) into self-contained shard specs, plus the
+  replica-side executor that turns one spec into analyzed ``BlobInfo``
+  dicts.
+- :mod:`trivy_tpu.fleet.coordinator` — fan shards out as async jobs over
+  the existing :class:`~trivy_tpu.rpc.client.RemoteDriver`
+  submit/wait surface to a ``--fleet host1,host2,...`` replica set, with
+  bounded per-replica in-flight, work-stealing for skewed shards,
+  speculative re-dispatch of stragglers (first result wins), per-replica
+  :class:`~trivy_tpu.parallel.mesh.CircuitBreaker` failure handling, and
+  an all-replicas-dead degradation to a local scan (the parity oracle).
+- :mod:`trivy_tpu.fleet.merge` — :class:`~trivy_tpu.fleet.merge.FleetArtifact`
+  folds shard results back into the standard scan path: blobs land in the
+  coordinator's cache under the exact keys a single-host scan would use,
+  the normal :class:`~trivy_tpu.scanner.local_driver.LocalDriver` merges
+  them through the applier (findings byte-identical to a single-host
+  scan), per-shard server ``Trace`` blocks join the coordinator's context
+  (one Perfetto timeline, replicas as distinct pids), and per-shard
+  progress aggregates into one coordinator-level
+  :class:`~trivy_tpu.obs.timeseries.ScanProgress`.
+
+Zero-cost-when-off: nothing in this package is imported (let alone
+allocated) unless ``--fleet`` is given — no coordinator threads, no pooled
+connections, no gauges (``bench --smoke`` asserts it).
+"""
+
+from __future__ import annotations
+
+
+class FleetError(RuntimeError):
+    """Unrecoverable fleet failure (every replica dead and host fallback
+    disabled, or a shard that cannot complete anywhere)."""
+
+
+def parse_fleet(hosts) -> list[str]:
+    """Normalize a ``--fleet`` value (list or comma-joined string) into a
+    deduplicated, order-preserving replica address list."""
+    if hosts is None:
+        return []
+    if isinstance(hosts, str):
+        hosts = hosts.split(",")
+    out: list[str] = []
+    for h in hosts:
+        h = str(h).strip()
+        if h and h not in out:
+            out.append(h)
+    return out

@@ -1,0 +1,635 @@
+"""Fleet coordinator: scatter shards to replicas, gather blobs back.
+
+Dispatch model: every replica gets ``inflight`` dedicated worker threads
+(the bounded per-replica in-flight window — each submit also rides the RPC
+client's admission-aware retry ladder, so a replica shedding with
+``Retry-After`` throttles its own window without stalling the others) and
+an affinity queue of shards kept largest-first. The failure ladder reuses
+the mesh semantics end to end:
+
+- **work-stealing**: a worker whose own queue drained takes the largest
+  shard still queued on the most-loaded peer — skewed shards re-balance
+  without a central scheduler tick;
+- **speculative re-dispatch**: an in-flight shard running past
+  ``speculate ×`` the median completed-shard wall time (floor
+  ``speculate_floor_s``) is handed to an otherwise-idle replica too; the
+  first result wins, the losing attempt is cancelled (its poll abandons);
+- **replica failure**: failures feed a per-replica
+  :class:`~trivy_tpu.parallel.mesh.CircuitBreaker` (same
+  threshold/half-open-probe/backoff ladder as device dispatch) and the
+  shard re-dispatches to a survivor;
+- **all replicas dead**: remaining shards degrade to a local
+  :func:`~trivy_tpu.fleet.plan.execute_shard` run (the parity oracle —
+  findings stay byte-identical, the report flips ``Degraded``) unless
+  ``--no-host-fallback`` keeps the failure loud.
+
+Observability folds into the coordinator's scan context: per-shard server
+``Trace`` blocks join via ``ctx.ingest_remote`` (one Perfetto timeline,
+replicas as distinct pids), per-shard progress polls aggregate into the
+scan's :class:`~trivy_tpu.obs.timeseries.ScanProgress`, and
+``fleet.dispatch`` / ``fleet.steal`` / ``fleet.result`` fault sites let
+the chaos harness prove every rung.
+"""
+
+from __future__ import annotations
+
+import statistics
+import threading
+import time
+from dataclasses import dataclass, field
+
+from trivy_tpu import faults, log, obs
+from trivy_tpu.fleet import FleetError, parse_fleet
+from trivy_tpu.fleet.plan import DEFAULT_SHARDS_PER_REPLICA
+
+logger = log.logger("fleet:coordinator")
+
+DEFAULT_INFLIGHT = 2  # async shard jobs in flight per replica
+DEFAULT_SPECULATE = 2.0  # straggler multiplier over the median shard time
+DEFAULT_SPECULATE_FLOOR_S = 10.0  # no speculation before this wall time
+DEFAULT_JOB_TIMEOUT = 600.0  # per-shard attempt wall cap
+DEFAULT_RUN_TIMEOUT = 3600.0  # whole-fan-out wall cap
+RESULT_POLL_S = 0.1
+PROGRESS_EVERY_POLLS = 5  # fold replica progress every Nth result poll
+
+
+@dataclass
+class FleetConfig:
+    """Resolved coordinator knobs (see BASELINE.md "Distributed scanning").
+    ``inflight`` resolves through :class:`~trivy_tpu.tuning.TuningConfig`
+    (CLI ``--fleet-inflight`` > ``TRIVY_TPU_FLEET_INFLIGHT`` > autotune
+    record > default 2) like every other perf knob."""
+
+    hosts: list = field(default_factory=list)
+    token: str = ""
+    inflight: int = DEFAULT_INFLIGHT
+    shards_per_replica: int = DEFAULT_SHARDS_PER_REPLICA
+    speculate: float = DEFAULT_SPECULATE  # 0 disables speculation
+    speculate_floor_s: float = DEFAULT_SPECULATE_FLOOR_S
+    host_fallback: bool = True
+    job_timeout: float = DEFAULT_JOB_TIMEOUT
+    run_timeout: float = DEFAULT_RUN_TIMEOUT
+    rpc_retries: int = 1  # replica-death detection must be fast — the
+    rpc_deadline: float = 10.0  # coordinator's ladder is the real retry
+    poll_s: float = RESULT_POLL_S
+
+    @classmethod
+    def from_opts(cls, opts: dict, tuning=None) -> "FleetConfig":
+        hosts = parse_fleet(opts.get("fleet"))
+        if not hosts:
+            raise ValueError("--fleet: at least one replica address required")
+        inflight = int(
+            opts.get("fleet_inflight")
+            or getattr(tuning, "fleet_inflight", 0)
+            or DEFAULT_INFLIGHT
+        )
+        speculate = opts.get("fleet_speculate")
+        cfg = cls(
+            hosts=hosts,
+            token=opts.get("token") or "",
+            inflight=max(1, inflight),
+            shards_per_replica=max(
+                1, int(opts.get("fleet_shards_per_replica")
+                       or DEFAULT_SHARDS_PER_REPLICA)
+            ),
+            host_fallback=not opts.get("no_host_fallback"),
+        )
+        if speculate is not None:
+            cfg.speculate = max(0.0, float(speculate))
+        return cfg
+
+    def target_shards(self) -> int:
+        return max(1, len(self.hosts) * self.shards_per_replica)
+
+
+class _ShardState:
+    """Coordinator-side bookkeeping for one shard across its attempts."""
+
+    __slots__ = (
+        "spec", "state", "running", "failed_on", "attempts", "started",
+        "speculated", "stolen", "done", "blobs", "counted",
+    )
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.state = "queued"  # queued | inflight | done | dead
+        self.running: set[int] = set()  # replica indexes mid-attempt
+        self.failed_on: set[int] = set()
+        self.attempts = 0
+        self.started = 0.0  # first-attempt start (speculation clock)
+        self.speculated = False
+        self.stolen = False
+        self.done = False
+        self.blobs: list | None = None
+        self.counted = 0  # replica-reported bytes already folded into progress
+
+
+class FleetCoordinator:
+    """One fan-out: ``run(shards)`` scatters, gathers, and returns
+    ``{shard index: [{"BlobID", "BlobInfo"}, ...]}``."""
+
+    def __init__(self, cfg: FleetConfig, scan_options, local_cache=None):
+        from trivy_tpu.parallel.mesh import CircuitBreaker
+        from trivy_tpu.rpc.client import RemoteDriver
+
+        self.cfg = cfg
+        self.scan_options = scan_options
+        self.local_cache = local_cache
+        self.drivers = [
+            RemoteDriver(
+                h, token=cfg.token, retries=cfg.rpc_retries,
+                deadline=cfg.rpc_deadline,
+            )
+            for h in cfg.hosts
+        ]
+        self.breaker = CircuitBreaker(
+            len(cfg.hosts), labels=[f"fleet:{h}" for h in cfg.hosts]
+        )
+        self._sync_only = [False] * len(cfg.hosts)  # 404 on submit → sync scan
+        self.stats = {
+            "replicas": len(cfg.hosts),
+            "shards": 0,
+            "dispatches": 0,
+            "steals": 0,
+            "speculative": 0,
+            "redispatches": 0,
+            "cancelled": 0,
+            "local_fallback": 0,
+            "replica_shards": {h: 0 for h in cfg.hosts},
+        }
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: list[list[_ShardState]] = []
+        self._shards: list[_ShardState] = []
+        self._durations: list[float] = []
+        self._stop = False
+
+    # -- queue mechanics (all under self._lock) ------------------------------
+
+    def _insert_sorted(self, q: list[_ShardState], shard: _ShardState) -> None:
+        for pos, s in enumerate(q):
+            if shard.spec.nbytes > s.spec.nbytes:
+                q.insert(pos, shard)
+                return
+        q.append(shard)
+
+    def _pending_locked(self) -> int:
+        return sum(1 for s in self._shards if s.state not in ("done", "dead"))
+
+    def _speculate_deadline_locked(self) -> float:
+        if self._durations:
+            return max(
+                self.cfg.speculate_floor_s,
+                self.cfg.speculate * statistics.median(self._durations),
+            )
+        return self.cfg.speculate_floor_s
+
+    def _take_locked(self, i: int) -> tuple[_ShardState | None, str]:
+        """Next shard for replica ``i``: own largest → stolen largest from
+        the most-loaded peer → speculative twin of the worst straggler."""
+        q = self._queues[i]
+        if q:
+            return q.pop(0), "own"
+        donors = [
+            j for j in range(len(self._queues)) if j != i and self._queues[j]
+        ]
+        if donors:
+            # largest stealable shard across peers (queues are sorted
+            # desc, so each queue's first eligible entry is its largest);
+            # shards this replica already failed on are not stealable —
+            # stealing back a shard that was deliberately requeued AWAY
+            # from us would burn attempts on a known-bad pairing
+            best = None
+            best_j = -1
+            for j in sorted(
+                donors,
+                key=lambda j: -sum(s.spec.nbytes for s in self._queues[j]),
+            ):
+                for s in self._queues[j]:
+                    if i in s.failed_on:
+                        continue
+                    if best is None or s.spec.nbytes > best.spec.nbytes:
+                        best, best_j = s, j
+                    break
+            if best is not None:
+                self._queues[best_j].remove(best)
+                best.stolen = True
+                self.stats["steals"] += 1
+                return best, "steal"
+        if self.cfg.speculate > 0:
+            now = time.monotonic()
+            deadline = self._speculate_deadline_locked()
+            cands = [
+                s for s in self._shards
+                if s.state == "inflight" and not s.done and not s.speculated
+                and i not in s.running and i not in s.failed_on
+                and now - s.started > deadline
+            ]
+            if cands:
+                shard = min(cands, key=lambda s: s.started)  # worst straggler
+                shard.speculated = True
+                self.stats["speculative"] += 1
+                return shard, "speculate"
+        return None, ""
+
+    def _eligible_work_locked(self, i: int) -> bool:
+        """Would :meth:`_take_locked` yield anything for replica ``i``?
+        Mirrors its filters without popping — the breaker's half-open
+        probe slot must only be claimed when there is an attempt to spend
+        it on (an empty-handed claim locks recovery out for the whole
+        probe timeout)."""
+        if self._queues[i]:
+            return True
+        for j, q in enumerate(self._queues):
+            if j != i and any(i not in s.failed_on for s in q):
+                return True
+        if self.cfg.speculate > 0:
+            now = time.monotonic()
+            deadline = self._speculate_deadline_locked()
+            return any(
+                s.state == "inflight" and not s.done and not s.speculated
+                and i not in s.running and i not in s.failed_on
+                and now - s.started > deadline
+                for s in self._shards
+            )
+        return False
+
+    def _requeue_locked(self, shard: _ShardState, avoid: int) -> None:
+        """Re-dispatch a failed shard to a survivor's queue (the replica
+        with the least queued bytes that hasn't already failed it;
+        everyone-failed resets the slate so breaker probes can retry it
+        until the attempt cap declares it dead)."""
+        n = len(self._queues)
+        cands = [
+            j for j in range(n)
+            if j != avoid and j not in shard.failed_on
+        ]
+        if not cands:
+            shard.failed_on.clear()
+            cands = [j for j in range(n) if j != avoid] or list(range(n))
+        target = min(
+            cands,
+            key=lambda j: (sum(s.spec.nbytes for s in self._queues[j]), j),
+        )
+        shard.state = "queued"
+        shard.speculated = False
+        self.stats["redispatches"] += 1
+        self._insert_sorted(self._queues[target], shard)
+
+    def _declare_fleet_dead_locked(self) -> None:
+        """All breakers open at once: every queued shard (and every
+        in-flight shard with no attempt still running) goes to the local
+        fallback; attempts still racing resolve themselves (their own
+        failure paths land here again)."""
+        for q in self._queues:
+            q.clear()
+        for s in self._shards:
+            if s.state in ("queued", "inflight") and not s.done \
+                    and not s.running:
+                s.state = "dead"
+
+    # -- the fan-out ---------------------------------------------------------
+
+    def run(self, specs) -> dict[int, list[dict]]:
+        ctx = obs.current()
+        n = len(self.cfg.hosts)
+        self._shards = [_ShardState(s) for s in specs]
+        self.stats["shards"] = len(self._shards)
+        ctx.count("fleet.shards", len(self._shards))
+        self._queues = [[] for _ in range(n)]
+        # round-robin the largest-first plan across affinity queues: each
+        # queue stays sorted desc, and loads start near-balanced
+        for k, shard in enumerate(self._shards):
+            self._queues[k % n].append(shard)
+        # the per-shard attempt cap bounds the all-dead detection time:
+        # a shard that failed this many times (across redispatches and
+        # breaker probes) is declared dead and handed to the fallback
+        self._attempt_cap = max(4, 2 * n)
+        workers = [
+            threading.Thread(
+                target=self._worker, args=(i, ctx), daemon=True,
+                name=f"fleet-worker-r{i}-{j}",
+            )
+            for i in range(n)
+            for j in range(self.cfg.inflight)
+        ]
+        deadline = time.monotonic() + self.cfg.run_timeout
+        for w in workers:
+            w.start()
+        try:
+            with self._cond:
+                while self._pending_locked() > 0:
+                    if time.monotonic() > deadline:
+                        raise FleetError(
+                            f"fleet scan exceeded {self.cfg.run_timeout:.0f}s"
+                            f" ({self._pending_locked()} shard(s) unfinished)"
+                        )
+                    self._cond.wait(0.1)
+        finally:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            for w in workers:
+                w.join(timeout=30.0)
+        dead = [s for s in self._shards if s.state == "dead"]
+        if dead:
+            self._fallback(dead, ctx)
+        # fold the fan-out's shape into the trace counters so --trace /
+        # --metrics-out carry the steal/speculation/redispatch story
+        for key in ("steals", "speculative", "redispatches"):
+            if self.stats[key]:
+                ctx.count(f"fleet.{key}", self.stats[key])
+        out = {}
+        for s in self._shards:
+            if s.blobs is None:
+                raise FleetError(f"{s.spec.label()} completed without blobs")
+            out[s.spec.index] = s.blobs
+        logger.info(
+            "fleet fan-out complete: %d shard(s) over %d replica(s) "
+            "(%d steal(s), %d speculative, %d redispatch(es), %d local)",
+            self.stats["shards"], n, self.stats["steals"],
+            self.stats["speculative"], self.stats["redispatches"],
+            self.stats["local_fallback"],
+        )
+        return out
+
+    def _worker(self, i: int, ctx) -> None:
+        with obs.activate(ctx):
+            while True:
+                with self._cond:
+                    if self._stop or self._pending_locked() == 0:
+                        return
+                    shard, how = (None, "")
+                    if not self.breaker.is_open(i):
+                        shard, how = self._take_locked(i)
+                    elif self._eligible_work_locked(i) \
+                            and self.breaker.try_probe(i):
+                        # an open breaker blocks dispatch until its
+                        # half-open probe window arrives — the probe slot
+                        # is claimed only when a take would actually yield
+                        # work, and try_probe touches ONLY replica i's
+                        # slot (next_device would claim a peer's as a
+                        # round-robin side effect)
+                        shard, how = self._take_locked(i)
+                    if shard is None:
+                        self._cond.wait(0.05)
+                        continue
+                    shard.running.add(i)
+                    shard.attempts += 1
+                    if shard.state == "queued":
+                        shard.state = "inflight"
+                        shard.started = time.monotonic()
+                if how == "steal":
+                    try:
+                        faults.check("fleet.steal", key=self.cfg.hosts[i])
+                    except Exception as e:
+                        # a faulted steal must put the shard back, never
+                        # lose it (the chaos harness drives this rung)
+                        logger.warning("steal on %s faulted: %s",
+                                       self.cfg.hosts[i], e)
+                        with self._cond:
+                            shard.running.discard(i)
+                            if not shard.done and not shard.running:
+                                shard.state = "queued"
+                                self._insert_sorted(self._queues[i], shard)
+                            self._cond.notify_all()
+                        continue
+                self._attempt(i, shard, ctx)
+
+    # -- one attempt ---------------------------------------------------------
+
+    def _attempt(self, i: int, shard: _ShardState, ctx) -> None:
+        host = self.cfg.hosts[i]
+        t0 = time.monotonic()
+        try:
+            faults.check("fleet.dispatch", key=host)
+            with self._lock:  # stats writes stay lock-consistent
+                self.stats["dispatches"] += 1
+            ctx.count("fleet.dispatches")
+            with ctx.span("fleet.dispatch"):
+                resp = self._dispatch(i, shard)
+            if resp is None:  # lost the speculation race mid-poll
+                with self._cond:
+                    shard.running.discard(i)
+                    self.stats["cancelled"] += 1
+                    ctx.count("fleet.cancelled")
+                    self._cond.notify_all()
+                return
+            faults.check("fleet.result", key=str(shard.spec.index))
+            blobs = resp.get("Blobs")
+            if blobs is None:
+                raise FleetError(
+                    f"replica {host} returned no Blobs for "
+                    f"{shard.spec.label()}"
+                )
+        except Exception as e:
+            self.breaker.record_failure(i)
+            logger.warning(
+                "%s failed on replica %s (attempt %d): %s",
+                shard.spec.label(), host, shard.attempts, e,
+            )
+            fleet_dead = all(
+                self.breaker.is_open(j) for j in range(len(self.cfg.hosts))
+            )
+            with self._cond:
+                shard.running.discard(i)
+                shard.failed_on.add(i)
+                if not shard.done and not shard.running:
+                    if fleet_dead or shard.attempts >= self._attempt_cap:
+                        # exhausted everywhere: hand it to the fallback
+                        shard.state = "dead"
+                        logger.error(
+                            "%s failed %d attempt(s); no dispatchable "
+                            "replica left — falling back to a local scan",
+                            shard.spec.label(), shard.attempts,
+                        )
+                    else:
+                        self._requeue_locked(shard, avoid=i)
+                if fleet_dead:
+                    # every replica's breaker is open at once: the fleet is
+                    # down — drain the queues NOW instead of burning one
+                    # backoff-throttled probe per shard per attempt-cap
+                    # round (the half-open ladder would take minutes)
+                    self._declare_fleet_dead_locked()
+                self._cond.notify_all()
+            return
+        self.breaker.record_success(i)
+        with self._cond:
+            shard.running.discard(i)
+            if shard.done:
+                # a twin attempt already won; this result is the loser
+                self.stats["cancelled"] += 1
+                ctx.count("fleet.cancelled")
+                self._cond.notify_all()
+                return
+            shard.done = True
+            shard.state = "done"
+            shard.blobs = list(blobs)
+            self._durations.append(time.monotonic() - t0)
+            self.stats["replica_shards"][host] += 1
+            self._cond.notify_all()
+        self._fold_result(shard, resp, ctx)
+
+    def _fold_result(self, shard: _ShardState, resp: dict, ctx) -> None:
+        """Merge one shard response's observability into the coordinator
+        scan: the replica's Trace block joins the timeline (a distinct pid
+        in the export), its health events (skipped files, degradations)
+        sum into the report metadata, and progress tops up to the shard's
+        planned bytes."""
+        if ctx.enabled and resp.get("Trace"):
+            ctx.ingest_remote(resp["Trace"])
+        for name, v in (resp.get("Health") or {}).items():
+            if v:
+                ctx.health_count(name, int(v))
+        with self._lock:
+            delta = shard.spec.nbytes - shard.counted
+            shard.counted = shard.spec.nbytes
+        if delta > 0:
+            ctx.progress().note_scanned(delta, files=0)
+
+    def _note_progress(self, shard: _ShardState, snap: dict, ctx) -> None:
+        scanned = int(snap.get("BytesScanned") or 0)
+        scanned = min(scanned, shard.spec.nbytes)
+        with self._lock:
+            delta = scanned - shard.counted
+            if delta <= 0 or shard.done:
+                return
+            shard.counted = scanned
+        ctx.progress().note_scanned(delta, files=0)
+
+    # -- replica RPC ---------------------------------------------------------
+
+    def _dispatch(self, i: int, shard: _ShardState):
+        """One attempt on replica ``i``: async submit + cancellable result
+        poll, falling back to a synchronous Scanner.Scan on replicas
+        without the job API. Returns the raw shard response, or None when
+        a speculation twin won while this attempt was in flight."""
+        from trivy_tpu.rpc.client import RPCError
+
+        driver = self.drivers[i]
+        ctx = obs.current()
+        label = shard.spec.label()
+        if not self._sync_only[i]:
+            try:
+                sub = driver.submit(
+                    label, "", [], self.scan_options, shard=shard.spec.wire
+                )
+            except RPCError as e:
+                if "HTTP 404" in str(e):
+                    # replica runs without admission control: no job API —
+                    # remember and fall through to the sync path
+                    self._sync_only[i] = True
+                    logger.info(
+                        "replica %s has no async job API; using "
+                        "synchronous shard scans", self.cfg.hosts[i],
+                    )
+                else:
+                    raise
+            else:
+                return self._poll_result(i, shard, sub["JobID"], ctx)
+        resp = driver.scan_shard(label, shard.spec.wire, self.scan_options)
+        if shard.done:
+            return None
+        return resp
+
+    def _poll_result(self, i: int, shard: _ShardState, job_id: str, ctx):
+        from trivy_tpu.rpc.client import RPCError
+
+        driver = self.drivers[i]
+        deadline = time.monotonic() + self.cfg.job_timeout
+        misses = 0
+        polls = 0
+        while True:
+            if shard.done or self._stop:
+                # the twin won, or the run was abandoned (timeout) —
+                # stop polling so worker joins don't outlive the scan
+                return None
+            try:
+                doc = driver.fetch_result(job_id)
+            except RPCError:
+                misses += 1
+                if misses > 3 or time.monotonic() >= deadline:
+                    raise
+                time.sleep(self.cfg.poll_s)
+                continue
+            misses = 0
+            status = doc.get("Status")
+            if status == "done":
+                return doc.get("Result") or {}
+            if status in ("failed", "expired", "rejected"):
+                raise RPCError(
+                    f"shard job {job_id[:8]}: {status}: "
+                    f"{doc.get('Error', '')}"
+                )
+            if time.monotonic() >= deadline:
+                raise RPCError(
+                    f"shard job {job_id[:8]}: still {status} after "
+                    f"{self.cfg.job_timeout:.0f}s"
+                )
+            polls += 1
+            if status == "running" and polls % PROGRESS_EVERY_POLLS == 0:
+                try:
+                    self._note_progress(
+                        shard, driver.progress(job_id), ctx
+                    )
+                except Exception:
+                    pass  # progress polling is advisory, never fatal
+            delay = self.cfg.poll_s
+            if status == "queued" and doc.get("RetryAfterSeconds"):
+                delay = min(
+                    2.0, max(delay, float(doc["RetryAfterSeconds"]))
+                )
+            time.sleep(delay)
+
+    # -- all-dead degradation ------------------------------------------------
+
+    def _fallback(self, dead: list[_ShardState], ctx) -> None:
+        if not self.cfg.host_fallback:
+            raise FleetError(
+                f"{len(dead)} shard(s) failed on every replica and "
+                "--no-host-fallback is set: "
+                + ", ".join(s.spec.label() for s in dead[:4])
+            )
+        if self.local_cache is None:
+            raise FleetError(
+                "no local cache available for the host-fallback scan"
+            )
+        from trivy_tpu.fleet import plan as fleet_plan
+        from trivy_tpu.obs import export as obs_export
+
+        logger.warning(
+            "fleet degraded: scanning %d shard(s) locally (every replica "
+            "is dead)", len(dead),
+        )
+        obs.note_scan_degraded()
+        for shard in dead:
+            # the local run is a pseudo-replica: it executes under a child
+            # context whose trace/health fold back exactly like a remote
+            # shard response, so one timeline still covers every shard
+            child = obs.TraceContext(
+                name=f"fleet-local:{shard.spec.label()}",
+                enabled=ctx.enabled, trace_id=ctx.trace_id,
+            )
+            with obs.activate(child):
+                with child.span("fleet.local_shard"):
+                    try:
+                        blobs = fleet_plan.execute_shard(
+                            shard.spec.wire, self.local_cache
+                        )
+                    except Exception as e:
+                        # the fallback is the last rung — surface its
+                        # failure as a clean FleetError (the command
+                        # layer's error path), not a raw traceback
+                        raise FleetError(
+                            f"local fallback for {shard.spec.label()} "
+                            f"failed: {e}"
+                        ) from e
+            resp: dict = {"Blobs": blobs, "Health": child.health_snapshot()}
+            if ctx.enabled:
+                resp["Trace"] = obs_export.context_doc(child)
+            shard.done = True
+            shard.state = "done"
+            shard.blobs = list(blobs)
+            self.stats["local_fallback"] += 1
+            ctx.count("fleet.local_fallback")
+            self._fold_result(shard, resp, ctx)

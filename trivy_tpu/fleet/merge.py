@@ -1,0 +1,155 @@
+"""Fleet merger: shard results back into one single-host-identical report.
+
+:class:`FleetArtifact` is the trick that keeps the merge byte-exact: it
+implements the standard ``Artifact.inspect()`` surface, so the ordinary
+``Scanner(artifact, LocalDriver(cache))`` pairing does the actual merging
+— every shard's blobs land in the coordinator's cache under the exact
+keys a single-host scan would have stored them (image layers keep their
+planned per-layer keys; fs partitions get content-addressed ids in
+deterministic partition order), and the untouched
+:func:`~trivy_tpu.fanal.applier.apply_layers` + result-assembly path
+produces the report. Dedup across overlapping layer paths, whiteout
+semantics, and stable finding order are therefore *inherited*, not
+re-implemented, and findings are byte-identical to a single-host scan by
+construction. ``Degraded`` / ``SkippedFiles`` metadata sums the same way:
+shard responses carry their health deltas and the coordinator folds them
+into the scan context the report reads.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from trivy_tpu import log, obs
+from trivy_tpu.fleet.coordinator import FleetConfig, FleetCoordinator
+from trivy_tpu.types import ArtifactReference
+
+logger = log.logger("fleet:merge")
+
+
+class FleetArtifact:
+    """Artifact facade that scatters analysis across the fleet and
+    gathers blobs into ``cache``; detection and report assembly then run
+    through the standard local driver path."""
+
+    def __init__(self, kind: str, target: str, cache, option,
+                 fleet_config: FleetConfig, scan_options):
+        if kind not in ("fs", "image"):
+            raise ValueError(f"fleet scans support fs/image, not {kind!r}")
+        self.kind = kind
+        self.type = "filesystem" if kind == "fs" else "container_image"
+        self.target = target
+        self.cache = cache
+        self.option = option
+        self.fleet_config = fleet_config
+        self.scan_options = scan_options
+        self.coordinator: FleetCoordinator | None = None  # set by inspect()
+
+    def stats(self) -> dict:
+        return dict(self.coordinator.stats) if self.coordinator else {}
+
+    def inspect(self) -> ArtifactReference:
+        from trivy_tpu.fleet import plan as fleet_plan
+
+        ctx = obs.current()
+        self.coordinator = FleetCoordinator(
+            self.fleet_config, self.scan_options, local_cache=self.cache
+        )
+        with ctx.span("fleet.plan"):
+            if self.kind == "fs":
+                return self._inspect_fs(ctx, fleet_plan)
+            return self._inspect_image(ctx, fleet_plan)
+
+    # -- fs ------------------------------------------------------------------
+
+    def _inspect_fs(self, ctx, fleet_plan) -> ArtifactReference:
+        shards, total_bytes, total_files = fleet_plan.plan_fs_shards(
+            self.target, self.option, self.scan_options,
+            self.fleet_config.target_shards(),
+        )
+        progress = ctx.progress()
+        progress.note_walked(total_bytes, files=total_files)
+        progress.finish_walk()
+        logger.info(
+            "fleet plan: %s -> %d shard(s) over %d replica(s) "
+            "(%.1f MiB, %d files)",
+            self.target, len(shards), len(self.fleet_config.hosts),
+            total_bytes / (1 << 20), total_files,
+        )
+        results = self.coordinator.run(shards)
+        # one blob per partition, applied in deterministic plan order —
+        # partitions are path-disjoint so apply_layers yields the same
+        # sorted union a single-host one-blob scan produces
+        blob_ids: list[str] = []
+        for idx in sorted(results):
+            for b in results[idx]:
+                self.cache.put_blob(b["BlobID"], b["BlobInfo"])
+                blob_ids.append(b["BlobID"])
+        artifact_id = "sha256:" + hashlib.sha256(
+            ("fleet:" + ":".join(blob_ids)).encode()
+        ).hexdigest()
+        name = self.target
+        if name != os.path.sep:
+            name = name.rstrip(os.path.sep)
+        return ArtifactReference(
+            name=name, type=self.type, id=artifact_id, blob_ids=blob_ids
+        )
+
+    # -- image ---------------------------------------------------------------
+
+    def _inspect_image(self, ctx, fleet_plan) -> ArtifactReference:
+        from trivy_tpu.artifact.image import (
+            DaemonImageArtifact,
+            new_image_artifact,
+        )
+        from trivy_tpu.fleet import FleetError
+
+        artifact = new_image_artifact(self.target, self.cache, self.option)
+        if isinstance(artifact, DaemonImageArtifact):
+            # the daemon export lives in a coordinator-local temp file the
+            # replicas cannot open, and the shard wire would carry the
+            # bare image REFERENCE — a replica would fall back to a
+            # registry pull of possibly DIFFERENT content under the same
+            # tag. Refuse loudly instead of scanning the wrong bytes
+            raise FleetError(
+                f"fleet image scans need an archive path or a registry "
+                f"reference the replicas can fetch; {self.target!r} "
+                "resolved to a local daemon export (save it to an archive "
+                "or push it to a registry first)"
+            )
+        plan = fleet_plan.plan_image_shards(
+            artifact, self.cache, self.scan_options
+        )
+        total = sum(s.nbytes for s in plan.shards)
+        progress = ctx.progress()
+        progress.note_walked(total, files=len(plan.shards))
+        progress.finish_walk()
+        logger.info(
+            "fleet plan: %s -> %d missing layer shard(s) over %d "
+            "replica(s) (%.1f MiB; %d layer(s) already cached)",
+            plan.name, len(plan.shards), len(self.fleet_config.hosts),
+            total / (1 << 20),
+            len(plan.blob_ids) - 1 - len(plan.shards),
+        )
+        if plan.shards:
+            results = self.coordinator.run(plan.shards)
+            for idx in sorted(results):
+                for b in results[idx]:
+                    self.cache.put_blob(b["BlobID"], b["BlobInfo"])
+        if plan.config_missing:
+            # image-config analysis (ENV secrets, history misconfig) is one
+            # tiny synthetic blob — the coordinator handles it locally
+            archive = artifact._open_source()
+            try:
+                blob = artifact._analyze_config(archive)
+            finally:
+                archive.close()
+            self.cache.put_blob(plan.config_key, blob.to_dict())
+        return ArtifactReference(
+            name=plan.name,
+            type=self.type,
+            id=plan.artifact_key,
+            blob_ids=plan.blob_ids,
+            image_metadata=plan.image_metadata,
+        )

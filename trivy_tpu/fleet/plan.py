@@ -1,0 +1,505 @@
+"""Shard planner + replica-side shard executor.
+
+An artifact splits at its natural boundaries into self-contained shard
+specs small enough to balance and steal, big enough that per-shard RPC
+overhead stays noise:
+
+- **Image artifacts** shard by layer: the per-layer cache diff
+  (``MissingBlobs``) already isolates layers, so every cached layer is
+  excluded from the plan outright (never shipped, never re-analyzed) and
+  each missing layer becomes one shard carrying the exact blob key the
+  single-host pipeline would store it under.
+- **Filesystem/repo artifacts** shard by deterministic walk partition:
+  one walk (same skip rules as a single-host scan) collects per-directory
+  units — directories stay atomic so sibling-file analyzers (lockfile +
+  manifest pairs) and Helm chart subtrees (anything under a directory
+  holding ``Chart.yaml``) never split across shards — then LPT-balances
+  the units into byte-balanced partitions. The plan is a pure function of
+  the tree: replanning an unchanged tree yields identical shards.
+
+The executor half (:func:`execute_shard`) runs on a replica (inside
+``ScanServer.scan`` when a request carries a ``Shard`` block) or locally
+as the all-replicas-dead fallback: it turns one spec into the same
+``BlobInfo`` dicts a single-host scan would produce, consulting the
+executing cache first so warmed replicas skip straight to the bytes that
+actually changed.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from trivy_tpu import faults, log, obs
+
+logger = log.logger("fleet:plan")
+
+# fs trees overpartition beyond the replica count so the largest-first
+# queue has grain for stealing and stragglers re-balance naturally
+DEFAULT_SHARDS_PER_REPLICA = 4
+
+# shard-executor read-ahead window (a shard is a slice of one host's walk,
+# and several shard jobs run concurrently per replica — keep the per-shard
+# window smaller than LocalFSArtifact's whole-scan bound)
+PREFETCH_BYTES = 64 << 20
+PREFETCH_FILES = 64
+
+
+@dataclass
+class ShardSpec:
+    """One self-contained unit of fleet work. ``wire`` is the JSON body a
+    replica executes; ``nbytes`` is the planner's balance/steal weight;
+    ``blob_ids`` are the cache keys this shard's blobs land under (image
+    shards know them up front; fs shards discover them post-analysis)."""
+
+    index: int
+    kind: str  # "fs" | "image-layer"
+    nbytes: int
+    wire: dict = field(default_factory=dict)
+    blob_ids: list = field(default_factory=list)
+
+    def label(self) -> str:
+        return f"shard {self.index} ({self.kind}, {self.nbytes >> 10} KiB)"
+
+
+def _analysis_wire(option, scan_options) -> dict:
+    """The analysis-affecting knobs a shard must carry so the replica's
+    analyzer group matches the coordinator's plan (cache keys and findings
+    both depend on it)."""
+    wire = {
+        "Scanners": list(getattr(scan_options, "scanners", ["secret"])),
+        "LicenseFull": bool(getattr(scan_options, "license_full", False)),
+        "Backend": getattr(option, "backend", "auto"),
+        "SkipFiles": list(getattr(option, "skip_files", [])),
+        "SkipDirs": list(getattr(option, "skip_dirs", [])),
+        "SharedArena": not (getattr(option, "analyzer_extra", None) or {}).get(
+            "no_shared_arena"
+        ),
+        "Parallel": int(getattr(option, "parallel", 0) or 0),
+    }
+    # a custom secret ruleset changes findings AND cache keys: ship the
+    # path (fleet fs mode already assumes a shared filesystem; a replica
+    # missing the file fails the shard LOUDLY instead of silently
+    # scanning with default rules — see shard_artifact_option)
+    secret_cfg = getattr(option, "secret_config_path", None)
+    if secret_cfg:
+        wire["SecretConfig"] = secret_cfg
+    # registry image sources need the coordinator's pull options on the
+    # replica (same trust domain as the token-authed RPC channel; the
+    # admission job table frees request docs at terminal states)
+    reg = {
+        "Insecure": bool(getattr(option, "insecure_registry", False)),
+        "Username": getattr(option, "registry_username", "") or "",
+        "Password": getattr(option, "registry_password", "") or "",
+        "Platform": getattr(option, "platform", "") or "",
+    }
+    if any(reg.values()):
+        wire["Registry"] = reg
+    return wire
+
+
+# -- filesystem planning -----------------------------------------------------
+
+
+def _walk_units(root: str, option) -> tuple[list[tuple[str, list, int]], int, int]:
+    """One deterministic walk → directory-atomic units.
+
+    Returns ``(units, total_bytes, total_files)`` where each unit is
+    ``(unit_key, [(rel, size), ...], bytes)``. A directory containing
+    ``Chart.yaml`` pulls its whole subtree into one unit (Helm chart
+    evaluation reads the chart as a whole); every other directory is its
+    own unit (sibling files — manifest + lockfile pairs — stay together).
+    """
+    from trivy_tpu.fanal.walker import FSWalker, WalkOption
+
+    walker = FSWalker(
+        WalkOption(
+            skip_files=list(getattr(option, "skip_files", [])),
+            skip_dirs=list(getattr(option, "skip_dirs", [])),
+        )
+    )
+    by_dir: dict[str, list[tuple[str, int]]] = {}
+    chart_roots: list[str] = []
+    total_bytes = 0
+    total_files = 0
+    for rel, info, _opener in walker.walk(root):
+        d = rel.rsplit("/", 1)[0] if "/" in rel else ""
+        by_dir.setdefault(d, []).append((rel, info.size))
+        total_bytes += info.size
+        total_files += 1
+        if rel.rsplit("/", 1)[-1] == "Chart.yaml":
+            chart_roots.append(d)
+    # fold every directory under a chart root into that root's unit
+    # (nearest enclosing chart wins, so nested charts stay whole too)
+    chart_roots.sort(key=len, reverse=True)
+
+    def unit_for(d: str) -> str:
+        for cr in chart_roots:  # longest (nearest enclosing) chart wins
+            if cr == "":
+                return ""
+            if d == cr or d.startswith(cr + "/"):
+                return cr
+        return d
+
+    units_map: dict[str, list[tuple[str, int]]] = {}
+    for d, files in by_dir.items():
+        units_map.setdefault(unit_for(d), []).extend(files)
+    units = []
+    for key in sorted(units_map):
+        files = sorted(units_map[key])
+        units.append((key, files, sum(s for _, s in files)))
+    return units, total_bytes, total_files
+
+
+def plan_fs_shards(root: str, option, scan_options,
+                   n_shards: int) -> tuple[list[ShardSpec], int, int]:
+    """Deterministic byte-balanced fs partition plan. Returns
+    ``(shards, total_bytes, total_files)``; shards come out largest-first
+    (the dispatch order the coordinator's queues want)."""
+    units, total_bytes, total_files = _walk_units(root, option)
+    n_shards = max(1, min(n_shards, len(units)) if units else 1)
+    # LPT: biggest unit first into the lightest bin; ties resolve by bin
+    # index so the plan is a pure function of the tree
+    bins: list[list] = [[] for _ in range(n_shards)]
+    loads = [0] * n_shards
+    for key, files, nbytes in sorted(
+        units, key=lambda u: (-u[2], u[0])
+    ):
+        i = min(range(n_shards), key=lambda j: (loads[j], j))
+        bins[i].extend(files)
+        loads[i] += nbytes
+    analysis = _analysis_wire(option, scan_options)
+    shards = []
+    order = sorted(range(n_shards), key=lambda j: (-loads[j], j))
+    for idx, j in enumerate(order):
+        if not bins[j]:
+            continue
+        paths = sorted(rel for rel, _ in bins[j])
+        shards.append(
+            ShardSpec(
+                index=idx,
+                kind="fs",
+                nbytes=loads[j],
+                wire={
+                    "Kind": "fs",
+                    "Root": os.path.abspath(root),
+                    "Paths": paths,
+                    "Bytes": loads[j],
+                    **analysis,
+                },
+            )
+        )
+    return shards, total_bytes, total_files
+
+
+# -- image planning ----------------------------------------------------------
+
+
+@dataclass
+class ImagePlan:
+    """Everything the merger needs to reassemble a fleet image scan into
+    the exact single-host reference: the full blob-id list (cached +
+    planned), artifact identity, and image metadata."""
+
+    name: str
+    artifact_key: str
+    blob_ids: list
+    config_key: str
+    config_missing: bool
+    image_metadata: dict
+    shards: list
+
+
+def plan_image_shards(artifact, cache, scan_options) -> ImagePlan:
+    """Per-layer shard plan for an image artifact: the coordinator-side
+    ``MissingBlobs`` diff excludes every cached layer up front, and each
+    missing layer becomes one shard carrying its planned blob key."""
+    archive = artifact._open_source()
+    try:
+        plan = artifact.layer_plan(archive)
+        blob_ids = plan["layer_keys"] + [plan["config_key"]]
+        _, missing = cache.missing_blobs(plan["artifact_key"], blob_ids)
+        missing_set = set(missing)
+        analysis = _analysis_wire(artifact.option, scan_options)
+        shards = []
+        history = plan["history"]
+        for i, (diff_id, lkey) in enumerate(
+            zip(plan["diff_ids"], plan["layer_keys"])
+        ):
+            if lkey not in missing_set:
+                continue
+            try:  # registry sources may not expose stored layer sizes;
+                nbytes = max(1, int(archive.layer_size(i)))
+            except Exception:  # weight 1 keeps the plan balanced by count
+                nbytes = 1
+            shards.append(
+                ShardSpec(
+                    index=len(shards),
+                    kind="image-layer",
+                    nbytes=nbytes,
+                    blob_ids=[lkey],
+                    wire={
+                        "Kind": "image-layer",
+                        "Archive": artifact.path,
+                        "Index": i,
+                        "DiffID": diff_id,
+                        "BlobID": lkey,
+                        "CreatedBy": (
+                            history[i].get("created_by", "")
+                            if i < len(history) else ""
+                        ),
+                        "SkipSecret": i in plan["base_layers"],
+                        "Bytes": nbytes,
+                        **analysis,
+                    },
+                )
+            )
+        # largest-first dispatch order, deterministic on ties
+        shards.sort(key=lambda s: (-s.nbytes, s.index))
+        for idx, s in enumerate(shards):
+            s.index = idx
+        cfg = archive.config
+        return ImagePlan(
+            name=archive.name,
+            artifact_key=plan["artifact_key"],
+            blob_ids=blob_ids,
+            config_key=plan["config_key"],
+            config_missing=plan["config_key"] in missing_set,
+            image_metadata={
+                "id": archive.image_id,
+                "diff_ids": plan["diff_ids"],
+                "config": {
+                    "architecture": cfg.get("architecture", ""),
+                    "created": cfg.get("created", ""),
+                    "os": cfg.get("os", ""),
+                    "config": cfg.get("config", {}),
+                },
+            },
+            shards=shards,
+        )
+    finally:
+        archive.close()
+
+
+# -- replica-side execution --------------------------------------------------
+
+
+def shard_artifact_option(shard: dict):
+    """Reconstruct the analysis-affecting :class:`ArtifactOption` a shard
+    spec carries — the replica's analyzer group (and so its cache keys and
+    findings) must match what the coordinator planned."""
+    from trivy_tpu.artifact.local_fs import ArtifactOption
+    from trivy_tpu.fanal.analyzer import AnalyzerType
+
+    scanners = list(shard.get("Scanners") or ["secret"])
+    license_full = bool(shard.get("LicenseFull"))
+    backend = shard.get("Backend") or "auto"
+    disabled = []
+    if "secret" not in scanners:
+        disabled.append(AnalyzerType.SECRET)
+    if "license" not in scanners:
+        disabled.append(AnalyzerType.LICENSE_FILE)
+        disabled.append(AnalyzerType.LICENSE_HEADER)
+    elif not license_full:
+        disabled.append(AnalyzerType.LICENSE_HEADER)
+    if "misconfig" not in scanners:
+        disabled.append(AnalyzerType.CONFIG)
+    extra: dict = {}
+    if (
+        "secret" in scanners
+        and "license" in scanners
+        and backend != "cpu"
+        and shard.get("SharedArena", True)
+    ):
+        from trivy_tpu.licensing.fused import FusedLicenseGate
+
+        extra["fused_license"] = FusedLicenseGate(license_full=license_full)
+    secret_cfg = shard.get("SecretConfig")
+    if secret_cfg and not os.path.exists(secret_cfg):
+        # the coordinator scans with a custom ruleset this host cannot
+        # see — silently falling back to default rules would return
+        # wrong findings AND poison the planned cache keys
+        raise FileNotFoundError(
+            f"secret config {secret_cfg!r} does not exist on this host — "
+            "fleet scans with --secret-config require replicas to share "
+            "the config file"
+        )
+    reg = shard.get("Registry") or {}
+    return ArtifactOption(
+        skip_files=list(shard.get("SkipFiles") or []),
+        skip_dirs=list(shard.get("SkipDirs") or []),
+        disabled_analyzers=disabled,
+        secret_config_path=secret_cfg or None,
+        backend=backend,
+        analyzer_extra=extra,
+        parallel=int(shard.get("Parallel") or 0),
+        insecure_registry=bool(reg.get("Insecure")),
+        registry_username=reg.get("Username", "") or "",
+        registry_password=reg.get("Password", "") or "",
+        platform=reg.get("Platform", "") or "",
+    )
+
+
+def execute_shard(shard: dict, cache) -> list[dict]:
+    """Run one shard spec to completion on the executing host (a replica's
+    ``ScanServer.scan``, or the coordinator's local fallback) and return
+    its ``[{"BlobID", "BlobInfo"}, ...]`` list. Progress notes land on the
+    active trace context, so a replica's shard scan feeds the standard
+    ``GET /scan/<job_id>/progress`` poll the coordinator aggregates."""
+    kind = shard.get("Kind")
+    if kind == "fs":
+        return _execute_fs_shard(shard, cache)
+    if kind == "image-layer":
+        return _execute_image_shard(shard, cache)
+    raise ValueError(f"unknown shard kind: {kind!r}")
+
+
+def _execute_fs_shard(shard: dict, cache) -> list[dict]:
+    from trivy_tpu.cache.key import calc_blob_key, calc_key
+    from trivy_tpu.fanal.analyzer import (
+        AnalyzerGroup,
+        AnalyzerOptions,
+        AnalysisResult,
+        note_file_skipped,
+    )
+    from trivy_tpu.fanal.handler import HandlerManager
+    from trivy_tpu.fanal.walker import FileInfo
+
+    option = shard_artifact_option(shard)
+    root = shard["Root"]
+    if not os.path.isdir(root):
+        # a replica that does not share the coordinator's filesystem must
+        # fail the shard LOUDLY — absorbing every path as a per-file
+        # TOCTOU skip would return an empty blob and a silently-wrong
+        # "successful" fleet scan (the coordinator's ladder then lands on
+        # a replica that does share it, or the local fallback)
+        raise FileNotFoundError(
+            f"fs shard root {root!r} does not exist on this host — fleet "
+            "fs scans require replicas to share the scanned filesystem"
+        )
+    group = AnalyzerGroup(
+        AnalyzerOptions(
+            disabled=option.disabled_analyzers,
+            secret_config_path=option.secret_config_path,
+            backend=option.backend,
+            root=root,
+            extra=option.analyzer_extra,
+        )
+    )
+    handlers = HandlerManager()
+    result = AnalysisResult()
+    post_files: dict = {}
+    progress = obs.current().progress()
+
+    def analyze(rel, info, fut):
+        try:
+            wanted = group.analyze_file(result, root, rel, info, fut.result)
+        except OSError as e:
+            # TOCTOU: the file vanished (or turned unreadable) between the
+            # plan walk and this read — skip it, count it, keep scanning
+            # (same discipline as the single-host walk)
+            note_file_skipped(rel, e)
+            progress.note_scanned(info.size)
+            return
+        for t, content in wanted.items():
+            post_files.setdefault(t, {})[rel] = content
+        progress.note_scanned(info.size)
+
+    try:
+        # reader pool prefetches contents ahead of the analyzer loop —
+        # the same read/analyze overlap the single-host fs artifact gets
+        # (bounded window so huge files cannot pile up in memory)
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        from trivy_tpu.artifact.local_fs import DEFAULT_PARALLEL
+
+        window: deque = deque()  # (rel, info, future)
+        buffered = 0
+        workers = option.parallel or DEFAULT_PARALLEL
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            for rel in shard.get("Paths") or []:
+                full = os.path.join(root, *rel.split("/"))
+                try:
+                    st = os.lstat(full)
+                except OSError as e:
+                    note_file_skipped(rel, e)
+                    continue
+                info = FileInfo.from_stat(st)
+                progress.note_walked(info.size)
+
+                def opener(path=full, rel=rel) -> bytes:
+                    faults.check("walker.read", key=rel)
+                    with open(path, "rb") as f:
+                        return f.read()
+
+                window.append((rel, info, pool.submit(opener)))
+                buffered += info.size
+                while buffered > PREFETCH_BYTES or len(window) > PREFETCH_FILES:
+                    r, i, fut = window.popleft()
+                    buffered -= i.size
+                    analyze(r, i, fut)
+            while window:
+                r, i, fut = window.popleft()
+                analyze(r, i, fut)
+        group.finalize(result, post_files)
+    except BaseException:
+        # a dying shard must not leak the analyzers' background device
+        # pipelines (threads + arena slabs)
+        group.abort()
+        raise
+    blob = result.to_blob_info()
+    handlers.post_handle(result, blob)
+    blob_dict = blob.to_dict()
+    blob_id = calc_key(
+        calc_blob_key(blob_dict),
+        analyzer_versions=group.versions(),
+        hook_versions=handlers.versions(),
+        skip_files=option.skip_files,
+        skip_dirs=option.skip_dirs,
+    )
+    _, missing = cache.missing_blobs(blob_id, [blob_id])
+    if missing:
+        cache.put_blob(blob_id, blob_dict)
+    return [{"BlobID": blob_id, "BlobInfo": blob_dict}]
+
+
+def _execute_image_shard(shard: dict, cache) -> list[dict]:
+    option = shard_artifact_option(shard)
+    blob_id = shard["BlobID"]
+    # warmed replica: the layer's analyzed blob is already cached under the
+    # exact key the coordinator planned — never re-walked, never re-analyzed
+    _, missing = cache.missing_blobs("", [blob_id])
+    if not missing:
+        cached = cache.get_blob(blob_id)
+        if cached is not None:
+            obs.current().count("fleet.layer_cache_hits")
+            return [{"BlobID": blob_id, "BlobInfo": cached}]
+    artifact = _image_artifact(shard["Archive"], cache, option)
+    progress = obs.current().progress()
+    progress.note_walked(int(shard.get("Bytes") or 0))
+    blob = artifact._analyze_layer(
+        shard["Index"],
+        shard.get("DiffID", ""),
+        shard.get("CreatedBy", ""),
+        bool(shard.get("SkipSecret")),
+    )
+    progress.note_scanned(int(shard.get("Bytes") or 0))
+    blob_dict = blob.to_dict()
+    cache.put_blob(blob_id, blob_dict)
+    return [{"BlobID": blob_id, "BlobInfo": blob_dict}]
+
+
+def _image_artifact(path: str, cache, option):
+    """Archive path when it exists on the executing host's filesystem
+    (shared storage / in-process fleets), else a registry reference — the
+    replica pulls its own layers, which is exactly the production shape
+    (layer bytes never cross the coordinator's link)."""
+    from trivy_tpu.artifact.image import (
+        ImageArchiveArtifact,
+        ImageRegistryArtifact,
+    )
+
+    if os.path.exists(path):
+        return ImageArchiveArtifact(path, cache, option)
+    return ImageRegistryArtifact(path, cache, option)

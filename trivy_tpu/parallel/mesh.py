@@ -190,6 +190,26 @@ class CircuitBreaker:
         with self._lock:
             return self._open[i]
 
+    def try_probe(self, i: int) -> bool:
+        """Dispatch gate for callers bound to a FIXED device (the fleet
+        coordinator's per-replica workers): True when ``i`` is closed, or
+        open with its half-open probe due and unclaimed — in which case
+        THIS call claims ``i``'s probe slot (and only ``i``'s; unlike
+        :meth:`next_device`, no peer's slot is touched)."""
+        now = self.clock()
+        with self._lock:
+            if not self._open[i]:
+                return True
+            probe_free = (
+                not self._probing[i]
+                or now - self._probe_at[i] >= self.probe_timeout
+            )
+            if probe_free and now >= self._open_until[i]:
+                self._probing[i] = True
+                self._probe_at[i] = now
+                return True
+            return False
+
     def open_devices(self) -> list[int]:
         with self._lock:
             return [i for i in range(self.n) if self._open[i]]

@@ -16,12 +16,12 @@ results) skip the ladder entirely and fail fast on :data:`POLL_TIMEOUT`.
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
 import threading
 import time
-import urllib.error
-import urllib.request
+import urllib.parse
 
 from trivy_tpu import faults, log, obs, rpc
 from trivy_tpu.scanner import ScanOptions
@@ -54,6 +54,213 @@ class RPCError(Exception):
     pass
 
 
+class ConnectionPool:
+    """Per-(scheme, host, port) pooled keep-alive HTTP connections.
+
+    Every request used to open a fresh TCP connection
+    (``urllib.request.urlopen``); the fleet coordinator's fan-out and
+    result-poll loops made that per-request setup a measurable cost, so
+    requests now ride bounded per-host keep-alive
+    :class:`http.client.HTTPConnection` pools instead. Safety rules:
+
+    - a connection is used by exactly one thread at a time (popped from
+      the pool, returned only after the response body is fully read);
+    - any socket-level failure invalidates the connection (closed and
+      dropped, never re-pooled) — with one transparent retry on a FRESH
+      connection when a *reused* connection fails before yielding a
+      response (the server legitimately closed an idle keep-alive socket
+      between requests; timeouts are excluded, they must surface);
+    - a response carrying ``Connection: close`` is honored (read fully,
+      then closed, not re-pooled) — shed replies with small bodies keep
+      the connection alive because the server drains them, which is
+      regression-tested client-side.
+    """
+
+    MAX_IDLE_PER_HOST = 4
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._idle: dict[tuple, list] = {}
+        self.created = 0
+        self.reused = 0
+        self.invalidated = 0
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "idle": sum(len(v) for v in self._idle.values()),
+                "hosts": len([k for k, v in self._idle.items() if v]),
+                "created": self.created,
+                "reused": self.reused,
+                "invalidated": self.invalidated,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            conns = [c for v in self._idle.values() for c in v]
+            self._idle.clear()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    # -- acquire / release ---------------------------------------------------
+
+    def _acquire(self, key: tuple, timeout: float, fresh: bool = False):
+        conn = None
+        if not fresh:
+            with self._lock:
+                lst = self._idle.get(key)
+                conn = lst.pop() if lst else None
+                if conn is not None:
+                    self.reused += 1
+        if conn is not None:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+        scheme, host, port = key
+        cls = (
+            http.client.HTTPSConnection
+            if scheme == "https"
+            else http.client.HTTPConnection
+        )
+        conn = cls(host, port, timeout=timeout)
+        with self._lock:
+            self.created += 1
+        return conn, False
+
+    def _release(self, key: tuple, conn) -> None:
+        with self._lock:
+            lst = self._idle.setdefault(key, [])
+            if conn.sock is not None and len(lst) < self.MAX_IDLE_PER_HOST:
+                lst.append(conn)
+                return
+        conn.close()
+
+    def _discard(self, conn) -> None:
+        with self._lock:
+            self.invalidated += 1
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    # -- one request ---------------------------------------------------------
+
+    @staticmethod
+    def _proxied(scheme: str, host: str) -> bool:
+        """Does the environment route this host through an HTTP proxy?
+        Pooled direct connections would silently bypass a mandatory
+        egress proxy that the old ``urlopen`` path honored."""
+        import urllib.request as _ur
+
+        if scheme not in _ur.getproxies():
+            return False
+        try:
+            return not _ur.proxy_bypass(host)
+        except Exception:
+            return True
+
+    @staticmethod
+    def _urllib_request(url: str, method: str, body: bytes | None,
+                        headers: dict, timeout: float):
+        """Legacy urllib path for proxied requests (keeps
+        HTTP(S)_PROXY/no_proxy semantics; no pooling through proxies).
+        Same ``(status, headers, data)`` contract as the pooled path —
+        error statuses are returned, not raised."""
+        import urllib.error as _ue
+        import urllib.request as _ur
+
+        req = _ur.Request(url, data=body, headers=headers, method=method)
+        try:
+            with _ur.urlopen(req, timeout=timeout) as resp:
+                return resp.status, resp.headers, resp.read()
+        except _ue.HTTPError as e:
+            return e.code, e.headers, e.read() or b""
+
+    def request(self, url: str, method: str, body: bytes | None,
+                headers: dict, timeout: float):
+        """One HTTP exchange over a pooled connection. Returns
+        ``(status, headers message, body bytes)``; raises ``OSError`` /
+        ``http.client.HTTPException`` on connectivity failures (the
+        caller's retry ladder classifies them)."""
+        parts = urllib.parse.urlsplit(url)
+        port = parts.port or (443 if parts.scheme == "https" else 80)
+        key = (parts.scheme, parts.hostname or "", port)
+        if self._proxied(parts.scheme, parts.hostname or ""):
+            return self._urllib_request(url, method, body, headers, timeout)
+        path = parts.path or "/"
+        if parts.query:
+            path += "?" + parts.query
+        force_fresh = False
+        for _ in range(2):
+            conn, reused = self._acquire(key, timeout, fresh=force_fresh)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (TimeoutError, http.client.HTTPException, OSError) as e:
+                self._discard(conn)
+                stale = reused and not isinstance(e, TimeoutError)
+                if stale and not force_fresh:
+                    # the server closed this keep-alive socket between
+                    # requests; one transparent retry on a fresh
+                    # connection (timeouts surface — retrying would
+                    # silently double the caller's wait)
+                    force_fresh = True
+                    continue
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                self._release(key, conn)
+            return resp.status, resp.headers, data
+        raise http.client.HTTPException(f"{url}: pooled request failed")
+
+
+_POOL = ConnectionPool()
+
+
+def pool_stats() -> dict:
+    """Live connection-pool counters (``bench --smoke`` asserts the pool
+    stays empty on fleet-off local scans)."""
+    return _POOL.stats()
+
+
+def pool_clear() -> None:
+    _POOL.clear()
+
+
+def _request_headers(token: str, token_header: str,
+                     gzip_body: bool) -> dict:
+    headers = {
+        "Content-Type": "application/json",
+        "Accept-Encoding": "gzip",
+        # distributed tracing: every request carries the active trace id
+        # (and the caller's open span as parent) so the server joins the
+        # client's trace instead of minting a fresh one, and server logs
+        # correlate with client traces even when tracing is off
+        "traceparent": obs.traceparent(),
+    }
+    if gzip_body:
+        headers["Content-Encoding"] = "gzip"
+    if token:
+        headers[token_header] = token
+    return headers
+
+
+def _decode_body(headers, data: bytes) -> bytes:
+    if headers.get("Content-Encoding") == "gzip":
+        import gzip as _gzip
+
+        return _gzip.decompress(data)
+    return data
+
+
 def _post(base: str, path: str, payload: dict, token: str, token_header: str,
           timeout: float, retries: int = MAX_RETRIES,
           deadline: float = RETRY_DEADLINE) -> dict:
@@ -68,50 +275,50 @@ def _post(base: str, path: str, payload: dict, token: str, token_header: str,
     start = time.monotonic()
     last: Exception | None = None
     for attempt in range(retries + 1):
-        req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"}
-        )
-        if body is not raw:
-            req.add_header("Content-Encoding", "gzip")
-        req.add_header("Accept-Encoding", "gzip")
-        # distributed tracing: every request carries the active trace id
-        # (and the caller's open span as parent) so the server joins the
-        # client's trace instead of minting a fresh one, and server logs
-        # correlate with client traces even when tracing is off
-        req.add_header("traceparent", obs.traceparent())
-        if token:
-            req.add_header(token_header, token)
         retry_after: float | None = None
         try:
             faults.check("rpc.post", key=path)
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                data = resp.read()
-                if resp.headers.get("Content-Encoding") == "gzip":
-                    data = _gzip.decompress(data)
-                return json.loads(data or b"{}")
-        except urllib.error.HTTPError as e:
-            if e.code in _RETRYABLE_HTTP and attempt < retries:
-                last = e
-                if e.code in _RETRY_AFTER_HTTP:
+            status, rheaders, data = _POOL.request(
+                url, "POST", body,
+                _request_headers(token, token_header, body is not raw),
+                timeout,
+            )
+            if status < 300:
+                # strictly 2xx: redirects are NOT followed (a replica
+                # address should point at the server, not a redirecting
+                # LB) — a 3xx must surface as an RPCError below, never be
+                # json-parsed as a success body
+                try:
+                    body_bytes = _decode_body(rheaders, data)
+                except OSError as e:
+                    # corrupt gzip payload (BadGzipFile is an OSError) is
+                    # deterministic, not connectivity — re-POSTing through
+                    # the jitter ladder would burn the whole deadline
+                    raise RPCError(
+                        f"{path}: bad response body: {e}"
+                    ) from e
+                return json.loads(body_bytes or b"{}")
+            if status in _RETRYABLE_HTTP and attempt < retries:
+                last = RPCError(f"{path}: HTTP {status}")
+                if status in _RETRY_AFTER_HTTP:
                     # a draining/overloaded/shedding server says when to
                     # come back (admission sheds carry a drain-rate-derived
                     # Retry-After on both 503 and 429)
                     try:
-                        ra = e.headers.get("Retry-After")
+                        ra = rheaders.get("Retry-After")
                         retry_after = float(ra) if ra else None
                     except (TypeError, ValueError):
                         retry_after = None
             else:
                 try:
-                    err_body = e.read() or b"{}"
-                    if e.headers.get("Content-Encoding") == "gzip":
-                        err_body = _gzip.decompress(err_body)
-                    detail = json.loads(err_body).get("error", "")
+                    detail = json.loads(
+                        _decode_body(rheaders, data) or b"{}"
+                    ).get("error", "")
                 except Exception:
                     detail = ""
-                raise RPCError(f"{path}: HTTP {e.code} {detail}".strip()) from e
+                raise RPCError(f"{path}: HTTP {status} {detail}".strip())
         except (
-            urllib.error.URLError, ConnectionError, TimeoutError,
+            OSError, http.client.HTTPException,
             faults.InjectedFault,  # default-kind rpc.post injections retry too
         ) as e:
             if attempt >= retries:
@@ -143,21 +350,25 @@ def _get_json(url: str, token: str, token_header: str, timeout: float,
               what: str) -> tuple[int, dict, dict]:
     """One read-only GET poll: (status, body, headers). No retry ladder
     and the short :data:`POLL_TIMEOUT`-style timeout — polls must fail
-    fast, the caller's loop is the retry."""
-    req = urllib.request.Request(url)
+    fast, the caller's loop is the retry (pooled keep-alive still applies:
+    a poll loop reuses one warm connection instead of a TCP handshake per
+    tick)."""
+    headers = {}
     if token:
-        req.add_header(token_header, token)
+        headers[token_header] = token
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return (
-                resp.status,
-                json.loads(resp.read() or b"{}"),
-                dict(resp.headers),
-            )
-    except urllib.error.HTTPError as e:
-        raise RPCError(f"{what}: HTTP {e.code}") from e
-    except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+        status, rheaders, data = _POOL.request(
+            url, "GET", None, headers, timeout
+        )
+    except (OSError, http.client.HTTPException) as e:
         raise RPCError(f"{what}: {e}") from e
+    if status >= 300:  # polls expect 200/202; redirects are config errors
+        raise RPCError(f"{what}: HTTP {status}")
+    return (
+        status,
+        json.loads(_decode_body(rheaders, data) or b"{}"),
+        dict(rheaders),
+    )
 
 
 def get_progress(server: str, trace_id: str, token: str = "",
@@ -274,18 +485,23 @@ class RemoteDriver:
 
     def submit(self, target: str, artifact_id: str, blob_ids: list[str],
                options: ScanOptions,
-               deadline_s: float | None = None) -> dict:
+               deadline_s: float | None = None,
+               shard: dict | None = None) -> dict:
         """Submit a scan to the server's admission queue
         (``POST /scan/submit``); returns the submit document (``JobID``,
         ``QueuePosition``, ...). Sheds (429/503 + Retry-After) ride the
         normal full-jitter retry loop, so a busy-but-draining queue turns
-        into a later accepted submit, not an error."""
+        into a later accepted submit, not an error. ``shard`` attaches a
+        fleet shard spec: the server then runs that shard's ANALYSIS and
+        the job result carries its ``Blobs`` instead of scan results."""
         import os as _os
 
         ctx = obs.current()
         payload = self._scan_payload(
             target, artifact_id, blob_ids, options, bool(ctx.enabled)
         )
+        if shard is not None:
+            payload["Shard"] = shard
         if deadline_s is not None:
             payload["DeadlineSeconds"] = deadline_s
         # submit is NOT idempotent on the wire (it enqueues); the key is
@@ -297,6 +513,23 @@ class RemoteDriver:
             self.base, rpc.SCAN_SUBMIT, payload, self.token,
             self.token_header, self.timeout, self.retries, self.deadline,
         )
+
+    def scan_shard(self, target: str, shard: dict,
+                   options: ScanOptions) -> dict:
+        """Synchronous fleet-shard execution (``Scanner.Scan`` with a
+        ``Shard`` block) for replicas running without admission control /
+        the async job API; returns the raw shard response
+        (``Blobs``/``Health``/``Trace``)."""
+        ctx = obs.current()
+        payload = self._scan_payload(target, "", [], options,
+                                     bool(ctx.enabled))
+        payload["Shard"] = shard
+        with ctx.span("rpc.scan"):
+            return _post(
+                self.base, rpc.SCANNER_SCAN, payload, self.token,
+                self.token_header, self.timeout, self.retries,
+                self.deadline,
+            )
 
     def fetch_result(self, job_id: str) -> dict:
         """One fail-fast poll of a submitted job's result document."""

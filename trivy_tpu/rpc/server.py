@@ -298,6 +298,13 @@ class ScanServer:
             list_all_pkgs=bool(req.get("Options", {}).get("ListAllPkgs")),
         )
         target = req.get("Target", "")
+        # fleet shard execution: a request carrying a Shard block runs the
+        # ANALYSIS of that shard on this replica (its own device + feed
+        # path) and returns the resulting blobs — detection and the merge
+        # through the applier stay on the coordinator. Rides the exact
+        # same trace-join / progress-registry / sampler / admission
+        # plumbing as a detection scan (the async job API works unchanged)
+        shard = req.get("Shard")
         # per-request trace context: concurrent scans record into disjoint
         # tables (each handler thread carries its own contextvar value), and
         # the aggregates feed the shared /metrics registry afterwards. When
@@ -325,9 +332,21 @@ class ScanServer:
             # TRIVY_TPU_TELEMETRY_INTERVAL, 0 disables) feeding the counter
             # tracks shipped back in the Trace block and the process gauges
             # on GET /metrics; the progress registry serves
-            # GET /scan/<trace_id>/progress while this request runs
+            # GET /scan/<trace_id>/progress while this request runs.
+            # A fleet shard job joins the COORDINATOR's trace id (so N
+            # shards merge into one timeline) but registers progress under
+            # its JOB id only: N concurrent shards share one trace id, and
+            # registering it would let the first to finish retire (and
+            # freeze) a sibling's live progress entry
             progress = ctx.progress()
-            self._progress_register(ctx.trace_id, progress)
+            if shard is not None:
+                progress_keys = [trace_id] if (
+                    trace_id and trace_id != ctx.trace_id
+                ) else []
+            else:
+                progress_keys = [ctx.trace_id]
+            for key in progress_keys:
+                self._progress_register(key, progress)
             # per-request sampler at the cadence validated ONCE at server
             # construction — a garbage TRIVY_TPU_TELEMETRY_INTERVAL fails
             # at boot, not as a 500 on the Nth scan request. (No tuning
@@ -343,13 +362,22 @@ class ScanServer:
                     logger, f"scan of {target or '<unnamed>'}", HEARTBEAT_SECS
                 ):
                     t0 = time.perf_counter()
-                    with ctx.span("server.scan"):
-                        results, os_info = self.driver.scan(
-                            target,
-                            req.get("ArtifactID", ""),
-                            list(req.get("BlobIDs", [])),
-                            options,
-                        )
+                    if shard is not None:
+                        from trivy_tpu.fleet import plan as fleet_plan
+
+                        with ctx.span("server.shard"):
+                            blobs = fleet_plan.execute_shard(
+                                shard, self.cache
+                            )
+                        results, os_info = [], None
+                    else:
+                        with ctx.span("server.scan"):
+                            results, os_info = self.driver.scan(
+                                target,
+                                req.get("ArtifactID", ""),
+                                list(req.get("BlobIDs", [])),
+                                options,
+                            )
                     dt = time.perf_counter() - t0
                 progress.finish()
             finally:
@@ -357,12 +385,19 @@ class ScanServer:
                 # the finished table then serves the last honest snapshot
                 if sampler is not None:
                     sampler.stop()
-                self._progress_retire(ctx.trace_id)
+                for key in progress_keys:
+                    self._progress_retire(key)
             self.metrics.observe_scan(ctx, dt)
-        resp = {
-            "OS": os_info.to_dict() if os_info else None,
-            "Results": [r.to_dict() for r in results],
-        }
+        if shard is not None:
+            # shard responses carry blobs plus this replica's health delta
+            # (skipped files, degradations) so the coordinator's merged
+            # report sums SkippedFiles/Degraded exactly like a local scan
+            resp = {"Blobs": blobs, "Health": ctx.health_snapshot()}
+        else:
+            resp = {
+                "OS": os_info.to_dict() if os_info else None,
+                "Results": [r.to_dict() for r in results],
+            }
         if req.get("WantTrace"):
             from trivy_tpu.obs import export as obs_export
 

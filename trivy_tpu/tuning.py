@@ -64,6 +64,7 @@ DEFAULT_TUNING_INTERVAL = 0.5
 # knobs TuningConfig owns; order is the canonical display/serialize order
 KNOBS = (
     "feed_streams", "inflight", "arena_slabs", "bucket_rungs", "parallel",
+    "fleet_inflight",
 )
 
 # env spellings per knob (the feed-path pair predates this module and is
@@ -74,6 +75,7 @@ _ENV_NAMES = {
     "arena_slabs": "TRIVY_TPU_ARENA_SLABS",
     "bucket_rungs": "TRIVY_TPU_BUCKET_RUNGS",
     "parallel": "TRIVY_TPU_PARALLEL",
+    "fleet_inflight": "TRIVY_TPU_FLEET_INFLIGHT",
 }
 
 
@@ -123,6 +125,7 @@ class TuningConfig:
     arena_slabs: int = 0    # chunk-arena slab count (0 = derived bound)
     bucket_rungs: int = 0   # dispatch bucket-ladder depth (0 = default: 3)
     parallel: int = 0       # host read/analyze workers (0 = DEFAULT_PARALLEL)
+    fleet_inflight: int = 0  # shard jobs in flight per fleet replica (0 = 2)
     controller: bool = False          # online mid-scan adaptation
     tuning_interval: float = DEFAULT_TUNING_INTERVAL
     topology: str = ""                # fingerprint this config resolved for
@@ -137,6 +140,7 @@ class TuningConfig:
             "arena_slabs": self.arena_slabs,
             "bucket_rungs": self.bucket_rungs,
             "parallel": self.parallel,
+            "fleet_inflight": self.fleet_inflight,
             "controller": self.controller,
             "tuning_interval": self.tuning_interval,
             "topology": self.topology,
@@ -263,6 +267,7 @@ def resolve_tuning(opts: dict | None = None, env: dict | None = None,
         "arena_slabs": "secret_arena_slabs",
         "bucket_rungs": "secret_bucket_rungs",
         "parallel": "parallel",
+        "fleet_inflight": "fleet_inflight",
     }
     if autotune_path is None:
         autotune_path = opts.get("tuning_file") or env.get(ENV_TUNING_FILE)
