@@ -829,3 +829,81 @@ class TestConnectionPool:
         # retried on a fresh connection — no error surfaced to the caller
         assert s1["invalidated"] - s0["invalidated"] >= 1
         assert s1["created"] - s0["created"] == 2
+
+
+class TestWarmReplicaDedup:
+    """Cross-replica dedup warming (ISSUE 15): the coordinator's warm
+    hit-store entries ride the first shard to each replica, whose scanner
+    then serves every row from the seeded store — zero uploads — with
+    findings byte-identical to a single-host scan."""
+
+    def test_warm_seed_serves_replica_rows(self, tmp_path):
+        from trivy_tpu.fanal.analyzers import secret as secret_analyzer
+        from trivy_tpu.secret.engine import ScannerConfig
+        from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+        cfg_path = str(tmp_path / "secret.yaml")
+        with open(cfg_path, "w") as f:
+            f.write(
+                "enable-builtin-rules:\n"
+                "  - github-pat\n  - slack-access-token\n"
+            )
+        root = make_tree(tmp_path, n_dirs=4)
+
+        # coordinator-side warm store: scan the same bytes locally (row
+        # digests are content-addressed, so paths don't matter) and
+        # export; the replica-side scanner resolves the same fingerprint
+        # (same config file content, same backend/chunking)
+        sc = TpuSecretScanner(ScannerConfig.from_yaml_file(cfg_path))
+        files = []
+        for d, _, names in os.walk(root):
+            for n in sorted(names):
+                full = os.path.join(d, n)
+                with open(full, "rb") as f:
+                    files.append((os.path.relpath(full, root), f.read()))
+        list(sc.scan_files(sorted(files)))
+        export = sc.export_warm_hits()
+        assert export
+
+        httpds, hosts = _fleet(1)
+        before_keys = set(secret_analyzer._scanner_cache)
+        try:
+            cfg = FleetConfig(
+                hosts=hosts, speculate=0.0, shards_per_replica=1,
+                warm_seed=export,
+            )
+            cache = new_cache("memory", None)
+            so = ScanOptions(scanners=["secret"])
+            art = FleetArtifact(
+                "fs", root, cache,
+                ArtifactOption(backend="auto", secret_config_path=cfg_path),
+                cfg, so,
+            )
+            report = Scanner(art, LocalDriver(cache)).scan_artifact(so)
+            assert art.stats()["warm_seeded"] == 1
+        finally:
+            _shutdown(httpds)
+        # findings parity vs a single host running the same ruleset
+        single_cache = new_cache("memory", None)
+        single = Scanner(
+            LocalFSArtifact(
+                root, single_cache,
+                ArtifactOption(backend="cpu", secret_config_path=cfg_path),
+            ),
+            LocalDriver(single_cache),
+        ).scan_artifact(so)
+        assert _results(report) == _results(single)
+        # the replica-side scanner(s) served every row from the seed
+        new_scanners = [
+            v[0] for k, v in secret_analyzer._scanner_cache.items()
+            if k not in before_keys
+            and getattr(v[0], "ruleset_fingerprint", None)
+            == sc.ruleset_fingerprint
+        ]
+        assert new_scanners, "replica never built a device scanner"
+        up = sum(s.stats.snapshot()["chunks_uploaded"] for s in new_scanners)
+        hit = sum(
+            s.stats.snapshot()["chunks_dedup_hit"] for s in new_scanners
+        )
+        assert up == 0 and hit > 0
+        _assert_no_fleet_threads()

@@ -146,3 +146,67 @@ def test_temp_archive_cleaned_up(daemon, tmp_path):
     assert os.path.exists(tmp)
     art.close()
     assert not os.path.exists(tmp)
+
+
+def test_resolution_order_walks_dead_docker_to_live_podman(
+    daemon, tmp_path, monkeypatch
+):
+    """Full docker→containerd→podman fallback chain, e2e (ISSUE 15
+    satellite / VERDICT weak #7): the docker socket EXISTS but nothing
+    listens (dead daemon), a containerd socket exists but its gRPC API is
+    unsupported (skipped with a note), and the podman socket is live and
+    holds the image — the walk must land on podman and the scan must
+    produce the image's findings."""
+    import socket as socket_mod
+
+    from trivy_tpu.fanal import image_daemon
+
+    # dead docker socket: bound once, listener closed — connects refuse
+    dead = str(tmp_path / "dead-docker.sock")
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.bind(dead)
+    s.close()
+    # containerd socket file present (as on every docker/k8s host)
+    ctrd = str(tmp_path / "containerd.sock")
+    open(ctrd, "w").close()
+    monkeypatch.delenv("DOCKER_HOST", raising=False)
+    monkeypatch.setattr(image_daemon, "DOCKER_SOCKETS", [dead])
+    monkeypatch.setattr(image_daemon, "CONTAINERD_SOCKETS", [ctrd])
+    monkeypatch.setattr(
+        image_daemon, "PODMAN_SOCKETS", [daemon.socket_path]
+    )
+
+    source = image_daemon.resolve_daemon_source(
+        "alpine:3.18", ["docker", "containerd", "podman", "remote"], _opt()
+    )
+    assert source is not None and source.api == "podman"
+    assert source.host == daemon.socket_path
+
+    # the same walk end to end through the artifact layer: findings come
+    # from the podman-exported archive, under the user's reference name
+    report = _scan("alpine:3.18", tmp_path / "cache", _opt())
+    assert report.artifact_name == "alpine:3.18"
+    findings = [s for r in report.results for s in r.secrets]
+    assert any(f.rule_id == "github-pat" for f in findings)
+
+
+def test_resolution_order_all_daemons_dead_falls_to_registry_gate(
+    tmp_path, monkeypatch
+):
+    """With every daemon socket dead/absent and 'remote' excluded, the
+    walk must end in a clear error — never a silent registry fallback."""
+    from trivy_tpu.fanal import image_daemon
+    from trivy_tpu.fanal.image_daemon import DaemonError
+
+    monkeypatch.delenv("DOCKER_HOST", raising=False)
+    monkeypatch.setattr(image_daemon, "DOCKER_SOCKETS", [])
+    monkeypatch.setattr(image_daemon, "CONTAINERD_SOCKETS", [])
+    monkeypatch.setattr(image_daemon, "PODMAN_SOCKETS", [])
+    from trivy_tpu.artifact.image import new_image_artifact
+    from trivy_tpu.cache import new_cache
+
+    with pytest.raises(DaemonError):
+        new_image_artifact(
+            "nope:latest", new_cache("memory"),
+            _opt(image_src=["docker", "containerd", "podman"]),
+        )

@@ -242,3 +242,63 @@ def test_rediss_verifies_certificates_by_default(fake_redis, monkeypatch):
     cache.close()
     assert created[-1].cafile == "/tmp/ca.pem"
     assert created[-1].verify_mode == ssl_mod.CERT_REQUIRED
+
+
+def test_pipelined_batch_get_set_one_round_trip_per_batch(fake_redis):
+    """Satellite (ISSUE 15): per-batch dedup lookups must cost ONE network
+    round trip per batch, not one per row — counted at the socket layer
+    (each ``sendall`` on the RESP connection is one round trip)."""
+    from trivy_tpu.cache.redis import RedisCache
+
+    cache = RedisCache(f"redis://127.0.0.1:{fake_redis.port}")
+    sends = []
+    real_sock = cache._resp.sock
+
+    class CountingSock:
+        def sendall(self, data):
+            sends.append(len(data))
+            return real_sock.sendall(data)
+
+        def __getattr__(self, name):
+            return getattr(real_sock, name)
+
+    cache._resp.sock = CountingSock()
+    pairs = {f"secret-hitv3:fp:{i:03d}": {"r": [i], "c": [], "n": 1, "l": None}
+             for i in range(64)}
+    cache.set_blobs(pairs)
+    assert len(sends) == 1  # 64 SETs, one socket write
+    got = cache.get_blobs(list(pairs) + ["secret-hitv3:fp:missing"])
+    assert len(sends) == 2  # 65 GETs, one more socket write
+    assert got == pairs  # the miss is simply absent
+    # the fake saw every command individually (real pipelining, not MGET)
+    sets = [c for c in fake_redis.commands if c[0] == "SET"]
+    assert len(sets) == 64
+    cache.close()
+
+
+def test_pipelined_batch_with_ttl_and_error_recovery(fake_redis):
+    from trivy_tpu.cache.redis import RedisCache
+
+    cache = RedisCache(f"redis://127.0.0.1:{fake_redis.port}", ttl=60)
+    cache.set_blobs({"k1": {"a": 1}, "k2": {"b": 2}})
+    assert fake_redis.ttls["fanal::blob::k1"] == 60
+    assert cache.get_blobs(["k1", "k2"]) == {"k1": {"a": 1}, "k2": {"b": 2}}
+    # corrupt entry in the middle of a batch: dropped, rest survive
+    fake_redis.data["fanal::blob::k1"] = b"{not json"
+    assert cache.get_blobs(["k1", "k2"]) == {"k2": {"b": 2}}
+    cache.close()
+
+
+def test_warm_blobs_enumerates_namespace(fake_redis):
+    from trivy_tpu.cache.redis import RedisCache
+
+    cache = RedisCache(f"redis://127.0.0.1:{fake_redis.port}")
+    cache.set_blobs({
+        "secret-hitv3:aa:01": {"r": []},
+        "secret-hitv3:aa:02": {"r": [1]},
+        "other:key": {"x": 1},
+    })
+    warm = cache.warm_blobs("secret-hitv3:aa:", limit=10)
+    assert set(warm) == {"secret-hitv3:aa:01", "secret-hitv3:aa:02"}
+    assert cache.warm_blobs("secret-hitv3:zz:", limit=10) == {}
+    cache.close()

@@ -472,6 +472,73 @@ class DaemonImageArtifact(ImageArchiveArtifact):
             pass
 
 
+def preseed_from_base(artifact: ImageArchiveArtifact, base_target: str,
+                      cache, option: ArtifactOption | None = None) -> dict:
+    """Diff-scan for images (``--diff-base <image-ref>``): make the scan
+    of a derived image analyze ONLY layers absent from its base.
+
+    The derived image's ``layer_plan()`` keys are computed as usual; every
+    planned-but-missing layer whose diff-ID also exists in the base image
+    is analyzed FROM THE BASE ARCHIVE (identical bytes by diff-ID) under
+    the derived plan's exact key — including the derived plan's
+    secret-skip decision for base layers, which a standalone scan of the
+    base would key differently. The subsequent ``inspect()``'s
+    ``MissingBlobs`` diff then sees those layers cached and never walks
+    them; layers already cached from a previous scan cost nothing here.
+
+    Returns ``{"shared", "seeded", "new"}`` counts for logging/tests."""
+    archive = artifact._open_source()
+    try:
+        plan = artifact.layer_plan(archive)
+        blob_ids = plan["layer_keys"] + [plan["config_key"]]
+        _, missing = cache.missing_blobs(plan["artifact_key"], blob_ids)
+        missing_set = set(missing)
+        todo = [
+            (i, d, k) for i, (d, k) in enumerate(
+                zip(plan["diff_ids"], plan["layer_keys"])
+            ) if k in missing_set
+        ]
+        if not todo:
+            return {"shared": 0, "seeded": 0, "new": 0}
+        base_artifact = new_image_artifact(base_target, cache, option)
+        base_archive = base_artifact._open_source()
+        try:
+            base_index = {d: i for i, d in enumerate(base_archive.diff_ids)}
+            history = plan["history"]
+            seeded = shared = 0
+            for i, diff_id, lkey in todo:
+                bi = base_index.get(diff_id)
+                if bi is None:
+                    continue
+                shared += 1
+                created_by = (
+                    history[i].get("created_by", "") if i < len(history)
+                    else ""
+                )
+                blob = base_artifact._analyze_layer(
+                    bi, diff_id, created_by,
+                    skip_secret=i in plan["base_layers"],
+                    archive=base_archive,  # serial: share one open source
+                )
+                cache.put_blob(lkey, blob.to_dict())
+                seeded += 1
+            logger.info(
+                "diff-base %s: %d shared layer(s) seeded from the base "
+                "(%d layer(s) remain to analyze from the target)",
+                base_target, seeded, len(todo) - shared,
+            )
+            return {
+                "shared": shared, "seeded": seeded,
+                "new": len(todo) - shared,
+            }
+        finally:
+            base_archive.close()
+            if hasattr(base_artifact, "close"):
+                base_artifact.close()
+    finally:
+        archive.close()
+
+
 def new_image_artifact(target: str, cache, option: ArtifactOption | None = None):
     """Archive path when it exists on disk, else daemon sources in
     ``--image-src`` order, else a registry reference — the resolution-order

@@ -15,6 +15,39 @@ from trivy_tpu.cache.fs import FSCache  # noqa: F401
 from trivy_tpu.cache.memory import MemoryCache  # noqa: F401
 
 
+def get_blobs(cache, blob_ids: list[str]) -> dict[str, dict]:
+    """Batched blob fetch against any backend: one pipelined round trip
+    where the backend supports it (redis), a plain loop otherwise."""
+    fn = getattr(cache, "get_blobs", None)
+    if fn is not None:
+        return fn(blob_ids)
+    out = {}
+    for b in blob_ids:
+        v = cache.get_blob(b)
+        if v is not None:
+            out[b] = v
+    return out
+
+
+def set_blobs(cache, pairs: dict[str, dict]) -> None:
+    """Batched blob store (see :func:`get_blobs`)."""
+    fn = getattr(cache, "set_blobs", None)
+    if fn is not None:
+        fn(pairs)
+        return
+    for b, info in pairs.items():
+        cache.put_blob(b, info)
+
+
+def warm_blobs(cache, prefix: str, limit: int = 1024) -> dict[str, dict]:
+    """Enumerate blob entries under a key prefix; {} when the backend
+    cannot enumerate (remote caches)."""
+    fn = getattr(cache, "warm_blobs", None)
+    if fn is None:
+        return {}
+    return fn(prefix, limit)
+
+
 def new_cache(backend: str = "fs", cache_dir: str | None = None, **kwargs):
     """Cache factory (ref: pkg/cache/cache.go New). ``kwargs`` reach the
     redis backend (ttl, ca_cert, client_cert, client_key)."""

@@ -73,6 +73,41 @@ class FSCache:
             except OSError:
                 pass
 
+    # -- batched blob access (no transport to batch over; plain loops) ------
+
+    def get_blobs(self, blob_ids: list[str]) -> dict[str, dict]:
+        out = {}
+        for b in blob_ids:
+            v = self.get_blob(b)
+            if v is not None:
+                out[b] = v
+        return out
+
+    def set_blobs(self, pairs: dict[str, dict]) -> None:
+        for b, info in pairs.items():
+            self.put_blob(b, info)
+
+    def warm_blobs(self, prefix: str, limit: int = 1024) -> dict[str, dict]:
+        """Enumerate blob entries under a key prefix (dedup-store warming).
+        Only exact for non-``sha256:``-prefixed namespaces — ``_fname``
+        strips that scheme, and the dedup namespaces never carry it."""
+        fname_prefix = self._fname(prefix)[: -len(".json")] if prefix else ""
+        out: dict[str, dict] = {}
+        try:
+            names = sorted(os.listdir(self._bdir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json") or not name.startswith(fname_prefix):
+                continue
+            key = name[: -len(".json")]
+            v = self.get_blob(key)
+            if v is not None:
+                out[key] = v
+                if len(out) >= limit:
+                    break
+        return out
+
     # -- LocalArtifactCache -------------------------------------------------
 
     def get_artifact(self, artifact_id: str) -> dict | None:

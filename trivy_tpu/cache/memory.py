@@ -27,6 +27,23 @@ class MemoryCache:
         for b in blob_ids:
             self._blobs.pop(b, None)
 
+    # -- batched blob access (one call per dedup batch) --------------------
+
+    def get_blobs(self, blob_ids: list[str]) -> dict[str, dict]:
+        return {b: self._blobs[b] for b in blob_ids if b in self._blobs}
+
+    def set_blobs(self, pairs: dict[str, dict]) -> None:
+        self._blobs.update(pairs)
+
+    def warm_blobs(self, prefix: str, limit: int = 1024) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for k, v in self._blobs.items():
+            if k.startswith(prefix):
+                out[k] = v
+                if len(out) >= limit:
+                    break
+        return out
+
     # -- LocalArtifactCache (read side) ------------------------------------
 
     def get_artifact(self, artifact_id: str) -> dict | None:

@@ -55,13 +55,36 @@ class _Resp:
         self.sock = sock
         self.rfile = sock.makefile("rb")
 
-    def command(self, *args: str | bytes):
+    @staticmethod
+    def _encode(args) -> bytes:
         out = [b"*%d\r\n" % len(args)]
         for a in args:
             b = a.encode() if isinstance(a, str) else a
             out.append(b"$%d\r\n%s\r\n" % (len(b), b))
-        self.sock.sendall(b"".join(out))
+        return b"".join(out)
+
+    def command(self, *args: str | bytes):
+        self.sock.sendall(self._encode(args))
         return self._reply()
+
+    def pipeline(self, cmds: list[tuple]) -> list:
+        """Send every command in ONE socket write, then read the replies
+        back in order — a whole dedup batch costs one network round trip
+        instead of one per row. A mid-pipeline ``-ERR`` reply must not
+        desync the stream, so server errors come back as exception VALUES
+        in the reply list (the caller decides whether they matter)."""
+        if not cmds:
+            return []
+        self.sock.sendall(b"".join(self._encode(c) for c in cmds))
+        replies = []
+        for _ in cmds:
+            try:
+                replies.append(self._reply())
+            except RedisConnectionError:
+                raise  # transport death: nothing further will arrive
+            except RedisError as e:
+                replies.append(e)
+        return replies
 
     def _reply(self):
         line = self.rfile.readline()
@@ -228,6 +251,110 @@ class RedisCache:
         except json.JSONDecodeError:
             logger.warning("corrupt cache entry %s dropped", key)
             return None
+
+    def _pipeline(self, cmds: list[tuple]) -> list:
+        """Pipelined commands with the same single reconnect-and-replay
+        discipline as :meth:`_cmd` (every cache command is idempotent)."""
+        try:
+            return self._resp.pipeline(cmds)
+        except (RedisConnectionError, OSError) as e:
+            if isinstance(e, RedisError) and not isinstance(e, RedisConnectionError):
+                raise
+            logger.warning("redis connection lost (%s); reconnecting once", e)
+            try:
+                self._resp.close()
+            except OSError:
+                pass
+            self._connect()
+            return self._resp.pipeline(cmds)
+
+    def _get_blobs_redis(self, blob_ids: list[str]) -> dict[str, dict]:
+        faults.check("cache.redis.get", key="<batch>")
+        replies = self._pipeline(
+            [("GET", BLOB_PREFIX + b) for b in blob_ids]
+        )
+        out: dict[str, dict] = {}
+        for bid, r in zip(blob_ids, replies):
+            if r is None or isinstance(r, Exception):
+                continue
+            try:
+                out[bid] = json.loads(r)
+            except (json.JSONDecodeError, TypeError):
+                logger.warning("corrupt cache entry %s dropped", bid)
+        return out
+
+    def _set_blobs_redis(self, pairs: dict[str, dict]) -> None:
+        faults.check("cache.redis.set", key="<batch>")
+        cmds = []
+        for bid, obj in pairs.items():
+            data = json.dumps(obj, separators=(",", ":"))
+            if self.ttl > 0:
+                cmds.append(
+                    ("SET", BLOB_PREFIX + bid, data, "EX", str(self.ttl))
+                )
+            else:
+                cmds.append(("SET", BLOB_PREFIX + bid, data))
+        self._pipeline(cmds)
+
+    def get_blobs(self, blob_ids: list[str]) -> dict[str, dict]:
+        """Batched blob fetch: ONE pipelined round trip for the whole id
+        list (the per-batch dedup lookup path)."""
+        if not blob_ids:
+            return {}
+        return self._do(
+            lambda: self._get_blobs_redis(list(blob_ids)),
+            lambda m: m.get_blobs(blob_ids),
+        )
+
+    def set_blobs(self, pairs: dict[str, dict]) -> None:
+        """Batched blob store: ONE pipelined round trip per batch."""
+        if not pairs:
+            return
+        self._do(
+            lambda: self._set_blobs_redis(dict(pairs)),
+            lambda m: m.set_blobs(pairs),
+        )
+
+    def _warm_blobs_redis(self, prefix: str, limit: int) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        cursor = "0"
+        while True:
+            reply = self._cmd(
+                "SCAN", cursor, "MATCH", BLOB_PREFIX + prefix + "*",
+                "COUNT", "100",
+            )
+            cursor = (
+                reply[0].decode()
+                if isinstance(reply[0], bytes)
+                else str(reply[0])
+            )
+            keys = [
+                k.decode() if isinstance(k, bytes) else k
+                for k in (reply[1] or [])
+            ]
+            if keys:
+                for full, r in zip(keys, self._pipeline(
+                    [("GET", k) for k in keys]
+                )):
+                    if r is None or isinstance(r, Exception):
+                        continue
+                    try:
+                        out[full[len(BLOB_PREFIX):]] = json.loads(r)
+                    except (json.JSONDecodeError, TypeError):
+                        continue
+                    if len(out) >= limit:
+                        return out
+            if cursor == "0":
+                break
+        return out
+
+    def warm_blobs(self, prefix: str, limit: int = 1024) -> dict[str, dict]:
+        """Enumerate up to ``limit`` blob entries under a key prefix — the
+        cross-replica warming export reads a dedup namespace this way."""
+        return self._do(
+            lambda: self._warm_blobs_redis(prefix, limit),
+            lambda m: m.warm_blobs(prefix, limit),
+        )
 
     def put_artifact(self, artifact_id: str, info: dict) -> None:
         self._do(

@@ -212,6 +212,40 @@ def secret_flags() -> FlagGroup:
                  config_name="secret.bucket-rungs",
                  help="dispatch bucket-ladder depth (0 = default 3: "
                       "B, B/2, B/4; each rung costs one kernel compile)"),
+            Flag("secret-dedup-mb", default=0, value_type=int,
+                 config_name="secret.dedup-mb",
+                 help="byte budget (MB) for the in-process dedup hit-store "
+                      "LRU (0 = default 32; env TRIVY_TPU_DEDUP_STORE_MB; "
+                      "the bound is bytes, not entries, so streaming scans "
+                      "keep flat RSS)"),
+        ],
+    )
+
+
+def incremental_flags() -> FlagGroup:
+    """Incremental scanning (README "Incremental scanning"): unit-level
+    content-addressed re-scans, git diff-scan, and stat-walk repeats."""
+    return FlagGroup(
+        "incremental",
+        [
+            Flag("incremental", default=False, value_type=bool,
+                 config_name="incremental.enabled",
+                 help="unit-level incremental scan: directory-atomic units "
+                      "are cached by content + analysis fingerprint and "
+                      "unchanged units merge out of the cache (findings "
+                      "byte-identical to a full scan)"),
+            Flag("diff-base", default=None, config_name="incremental.diff-base",
+                 help="scan only what changed since this base: a git "
+                      "commit-ish (fs/repo targets; unchanged files keyed "
+                      "from the manifest recorded at that commit) or a "
+                      "base image ref/archive (image targets; layers "
+                      "present in the base are seeded from it, only new "
+                      "layers are analyzed)"),
+            Flag("since-last", default=False, value_type=bool,
+                 config_name="incremental.since-last",
+                 help="stat-walk repeat scan: files whose (size, mtime) "
+                      "match the last scan's manifest are not even read — "
+                      "an unchanged tree re-scans as a near-no-op"),
         ],
     )
 
@@ -423,16 +457,18 @@ def fleet_flags() -> FlagGroup:
 _TARGET_GROUPS = {
     "fs": [global_flags, scan_flags, report_flags, secret_flags, license_flags,
            misconf_flags, db_flags, server_client_flags, fleet_flags,
-           tuning_flags],
+           tuning_flags, incremental_flags],
     "rootfs": [global_flags, scan_flags, report_flags, secret_flags,
                license_flags, misconf_flags, db_flags, server_client_flags,
-               fleet_flags, tuning_flags],
+               fleet_flags, tuning_flags, incremental_flags],
     "repo": [global_flags, scan_flags, report_flags, secret_flags,
              license_flags, misconf_flags, db_flags, server_client_flags,
-             fleet_flags, tuning_flags],
+             fleet_flags, tuning_flags, incremental_flags],
+    "watch": [global_flags, scan_flags, report_flags, secret_flags,
+              license_flags, misconf_flags, db_flags, tuning_flags],
     "image": [global_flags, scan_flags, report_flags, secret_flags,
               license_flags, misconf_flags, db_flags, server_client_flags,
-              image_flags, fleet_flags, tuning_flags],
+              image_flags, fleet_flags, tuning_flags, incremental_flags],
     "vm": [global_flags, scan_flags, report_flags, secret_flags,
            license_flags, misconf_flags, db_flags, server_client_flags,
            tuning_flags],
@@ -455,6 +491,7 @@ def build_parser() -> argparse.ArgumentParser:
         "fs": "scan a local filesystem",
         "rootfs": "scan an exported root filesystem",
         "repo": "scan a git repository (local path or remote URL)",
+        "watch": "watch a directory: incremental re-scan on change (CI mode)",
         "image": "scan a container image (archive, OCI layout, or registry ref)",
         "vm": "scan a VM disk image (raw; MBR/GPT + ext4)",
         "sbom": "scan an SBOM (CycloneDX/SPDX) for vulnerabilities",
@@ -484,6 +521,14 @@ def build_parser() -> argparse.ArgumentParser:
             p.add_argument("--tag", default=None, help="tag to check out")
             p.add_argument("--commit", default=None, help="commit to check out")
             p.add_argument("target", help="repository path or URL")
+        elif cmd == "watch":
+            p.add_argument("--watch-interval", default=2.0, type=float,
+                           dest="watch_interval",
+                           help="seconds between re-scans (default 2)")
+            p.add_argument("--watch-count", default=0, type=int,
+                           dest="watch_count",
+                           help="stop after N scans (0 = run until ^C)")
+            p.add_argument("target", help="directory to watch")
         elif cmd == "image":
             # ref: trivy image --input for archives; positional for names
             p.add_argument("--input", default=None,

@@ -47,6 +47,7 @@ def _resolve_tuning(opts):
         "secret_bucket_rungs": opts.get("secret_bucket_rungs"),
         "parallel": opts.get("parallel"),
         "fleet_inflight": opts.get("fleet_inflight"),
+        "secret_dedup_mb": opts.get("secret_dedup_mb"),
         "tuning_file": opts.get("tuning_file"),
         # the store_true default (False) must not shadow the env layer:
         # only an EXPLICIT --tune is a CLI-level decision
@@ -187,7 +188,9 @@ def run(command: str, ns, opts) -> int:
     def on_timeout(signum, frame):
         raise TimeoutError(f"scan exceeded --timeout={timeout}s")
 
-    if timeout > 0 and command != "server":
+    # long-running commands (server, watch loop) are not one scan — the
+    # per-scan --timeout alarm does not apply to them
+    if timeout > 0 and command not in ("server", "watch"):
         signal.signal(signal.SIGALRM, on_timeout)
         signal.alarm(timeout)
     from trivy_tpu.result import IgnorePolicy, PolicyError
@@ -250,6 +253,8 @@ def run(command: str, ns, opts) -> int:
                 IgnorePolicy(opts["ignore_policy"])
             if command in ("fs", "rootfs", "repo"):
                 rc = _run_fs_like(command, ns, opts)
+            elif command == "watch":
+                rc = _run_watch(ns, opts)
             elif command == "image":
                 rc = _run_image(ns, opts)
             elif command == "vm":
@@ -282,7 +287,7 @@ def run(command: str, ns, opts) -> int:
         finally:
             if opts.get("fault_inject"):
                 faults.clear()
-            if timeout > 0 and command != "server":
+            if timeout > 0 and command not in ("server", "watch"):
                 signal.alarm(0)
             # telemetry teardown runs on EVERY exit path (completion, scan
             # death, timeout): stop the sampler (one final tick), then the
@@ -382,6 +387,20 @@ def _emit(report, ns, opts) -> int:
     return 0
 
 
+def _incremental_options(opts):
+    """IncrementalOptions when any incremental flag is set, else None —
+    incremental-off scans must allocate NOTHING (no manifest I/O, no unit
+    planner, not even the module import; bench --smoke asserts this)."""
+    if not (
+        opts.get("incremental") or opts.get("diff_base")
+        or opts.get("since_last")
+    ):
+        return None
+    from trivy_tpu.incremental import IncrementalOptions
+
+    return IncrementalOptions.from_opts(opts)
+
+
 def _run_fs_like(command: str, ns, opts) -> int:
     from trivy_tpu.artifact.local_fs import LocalFSArtifact
 
@@ -406,6 +425,7 @@ def _run_fs_like(command: str, ns, opts) -> int:
             return 1
 
     server = opts.get("server")
+    incr = _incremental_options(opts)
     if opts.get("fleet"):
         # fleet mode: the artifact splits into shards that fan out across
         # the replica set; blobs merge back through the standard local
@@ -413,8 +433,24 @@ def _run_fs_like(command: str, ns, opts) -> int:
         if server:
             logger.error("--fleet and --server are mutually exclusive")
             return 2
+        if incr is not None:
+            logger.error(
+                "--incremental/--diff-base/--since-last do not compose "
+                "with --fleet yet (replicas already skip cached layers; "
+                "use the shared cache backend for cross-scan reuse)"
+            )
+            return 2
         return _run_fleet("fs", target, ns, opts, art_opt)
     if server:
+        if incr is not None:
+            # client-mode analysis ships blobs to the server's cache; the
+            # unit-level diff needs a readable local cache — refuse loudly
+            # instead of silently full-scanning
+            logger.error(
+                "--incremental/--diff-base/--since-last require a local "
+                "scan path (drop --server or run the scan on the server)"
+            )
+            return 2
         # client mode: analysis is local, blobs ship to the SERVER's cache
         # and detection runs there (ref: run.go:348-355 split)
         from trivy_tpu.rpc.client import RemoteCache, RemoteDriver
@@ -426,10 +462,75 @@ def _run_fs_like(command: str, ns, opts) -> int:
 
         cache = _make_cache(opts)
         driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    if incr is not None:
+        from trivy_tpu.incremental.fs import IncrementalFSArtifact
+        from trivy_tpu.incremental.manifest import GitDiffError
+
+        artifact = IncrementalFSArtifact(target, cache, art_opt, incr)
+        try:
+            report = Scanner(artifact, driver).scan_artifact(
+                _scan_options(opts)
+            )
+        except GitDiffError as e:
+            # typoed --diff-base ref / not a git worktree: a clean error,
+            # not a traceback — and never a silent full scan
+            logger.error("--diff-base %s: %s", incr.diff_base, e)
+            return 1
+        return _emit(report, ns, opts)
     artifact = LocalFSArtifact(target, cache, art_opt)
     scanner = Scanner(artifact, driver)
     report = scanner.scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)
+
+
+def _run_watch(ns, opts) -> int:
+    """``trivy-tpu watch <path>``: scan, then re-scan on an interval —
+    each iteration is a ``--since-last`` incremental scan, so an unchanged
+    tree costs a stat-walk and a report is emitted only when something
+    actually changed (the unit diff is the change detector)."""
+    import time as time_mod
+
+    from trivy_tpu.incremental import IncrementalOptions
+    from trivy_tpu.incremental.fs import IncrementalFSArtifact
+    from trivy_tpu.scanner.local_driver import LocalDriver
+
+    interval = float(getattr(ns, "watch_interval", 0) or 2.0)
+    max_scans = int(getattr(ns, "watch_count", 0) or 0)  # 0 = forever
+    art_opt = _artifact_option(ns, opts)
+    cache = _make_cache(opts)
+    driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
+    incr = IncrementalOptions(enabled=True, since_last=True)
+    rc = 0
+    n = 0
+    prev_keys: tuple = ()
+    try:
+        while True:
+            n += 1
+            artifact = IncrementalFSArtifact(ns.target, cache, art_opt, incr)
+            report = Scanner(artifact, driver).scan_artifact(
+                _scan_options(opts)
+            )
+            # the unit diff is the change detector: edits/new files dirty
+            # a unit; deletions change the unit-key set, which the NEXT
+            # scan's artifact id reflects — compare it across iterations
+            changed = artifact.last_stats.get("units_analyzed", 0) > 0
+            key_set = tuple(sorted(artifact.last_stats.get("unit_keys", ())))
+            if n == 1 or changed or key_set != prev_keys:
+                rc = _emit(report, ns, opts)
+                logger.info(
+                    "watch scan #%d: %d/%d unit(s) re-analyzed", n,
+                    artifact.last_stats.get("units_analyzed", 0),
+                    artifact.last_stats.get("units_total", 0),
+                )
+            else:
+                logger.info("watch scan #%d: no changes", n)
+            prev_keys = key_set
+            if max_scans and n >= max_scans:
+                return rc
+            time_mod.sleep(interval)
+    except KeyboardInterrupt:
+        logger.info("watch stopped after %d scan(s)", n)
+        return rc
 
 
 def _run_fleet(kind: str, target: str, ns, opts, art_opt) -> int:
@@ -449,6 +550,22 @@ def _run_fleet(kind: str, target: str, ns, opts, art_opt) -> int:
         logger.error("%s", e)
         return 2
     cache = _make_cache(opts)
+    if opts.get("secret_hit_cache"):
+        # cross-replica dedup warming: export the coordinator's persisted
+        # hit-store namespaces (no scanner build, no jax) and ship them on
+        # each replica's first shard — a fresh replica joins re-scans warm
+        from trivy_tpu.secret.hitstore import export_backend_warm
+
+        try:
+            fleet_cfg.warm_seed = export_backend_warm(cache)
+        except Exception as e:
+            logger.warning("dedup warm export skipped: %s", e)
+        if fleet_cfg.warm_seed:
+            logger.info(
+                "fleet dedup warming: %d entr%s exported for replica "
+                "pre-seeding", len(fleet_cfg.warm_seed),
+                "y" if len(fleet_cfg.warm_seed) == 1 else "ies",
+            )
     artifact = FleetArtifact(
         kind, target, cache, art_opt, fleet_cfg, _scan_options(opts)
     )
@@ -476,7 +593,23 @@ def _run_image(ns, opts) -> int:
         return _run_fleet("image", target, ns, opts,
                           _artifact_option(ns, opts))
     cache = _make_cache(opts)
-    artifact = new_image_artifact(target, cache, _artifact_option(ns, opts))
+    art_opt = _artifact_option(ns, opts)
+    artifact = new_image_artifact(target, cache, art_opt)
+    diff_base = opts.get("diff_base")
+    if diff_base:
+        # diff-scan for images: seed the cache with the base image's
+        # layers under the derived plan's keys so inspect()'s
+        # MissingBlobs diff analyzes only layers absent from the base
+        from trivy_tpu.artifact.image import preseed_from_base
+
+        try:
+            preseed_from_base(artifact, diff_base, cache, art_opt)
+        except Exception as e:
+            # unreadable archive, daemon/registry resolution failure
+            # (DaemonError), bad layout — the user asked for a diff scan
+            # against this base, so fail loud, never silently full-scan
+            logger.error("--diff-base %s: %s", diff_base, e)
+            return 1
     driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
     report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)

@@ -57,6 +57,7 @@ def _shared_scanner(
         tuning_key = (
             tuning.feed_streams, tuning.inflight, tuning.arena_slabs,
             tuning.bucket_rungs, tuning.controller, tuning.tuning_interval,
+            tuning.dedup_store_mb,
         )
     key = (
         id(config) if config is not None else None,
@@ -231,6 +232,9 @@ class SecretAnalyzer(BatchAnalyzer):
         # fused license gate (shared-arena pass), created by commands.py
         # when --scanners includes both secret and license
         self._lic_gate = extra.get("fused_license")
+        # cross-replica dedup warming: a peer's exported hit-store entries
+        # to pre-seed the scanner's store with (fleet shard wire)
+        self._hit_seed = extra.get("secret_hit_seed")
         self._scanner = None  # built lazily so CPU-only runs never touch jax
         self._stream: _StreamScan | None = None
         self._found: list = []
@@ -276,6 +280,11 @@ class SecretAnalyzer(BatchAnalyzer):
                 feed_streams=self._feed_streams, inflight=self._inflight,
                 prefilter=self._prefilter, tuning=self._tuning,
             )
+            if self._hit_seed and hasattr(self._scanner, "seed_hit_entries"):
+                n = self._scanner.seed_hit_entries(self._hit_seed)
+                logger.info("dedup store warm-seeded with %d entr%s",
+                            n, "y" if n == 1 else "ies")
+                self._hit_seed = None
         return self._scanner.exact if hasattr(self._scanner, "exact") else self._scanner
 
     @staticmethod

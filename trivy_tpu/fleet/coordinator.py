@@ -69,6 +69,11 @@ class FleetConfig:
     host_fallback: bool = True
     job_timeout: float = DEFAULT_JOB_TIMEOUT
     run_timeout: float = DEFAULT_RUN_TIMEOUT
+    # cross-replica dedup warming: warm hit-store entries
+    # ([[persist_key, doc], ...]) shipped once per replica on its first
+    # shard so a fresh replica joins a re-scan warm (PR 11's named
+    # headroom; entries are namespace-keyed, replicas drop mismatches)
+    warm_seed: list = field(default_factory=list)
     rpc_retries: int = 1  # replica-death detection must be fast — the
     rpc_deadline: float = 10.0  # coordinator's ladder is the real retry
     poll_s: float = RESULT_POLL_S
@@ -155,8 +160,10 @@ class FleetCoordinator:
             "redispatches": 0,
             "cancelled": 0,
             "local_fallback": 0,
+            "warm_seeded": 0,  # replicas sent a warm dedup payload
             "replica_shards": {h: 0 for h in cfg.hosts},
         }
+        self._warm_sent: set[int] = set()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: list[list[_ShardState]] = []
@@ -509,10 +516,22 @@ class FleetCoordinator:
         driver = self.drivers[i]
         ctx = obs.current()
         label = shard.spec.label()
+        wire = shard.spec.wire
+        if self.cfg.warm_seed:
+            with self._lock:
+                first = i not in self._warm_sent
+                self._warm_sent.add(i)
+            if first:
+                # first shard to each replica carries the warm dedup
+                # entries; retries/steals re-send only if this attempt
+                # never reached the replica (sent-set stays conservative)
+                wire = dict(wire)
+                wire["WarmHits"] = self.cfg.warm_seed
+                self.stats["warm_seeded"] += 1
         if not self._sync_only[i]:
             try:
                 sub = driver.submit(
-                    label, "", [], self.scan_options, shard=shard.spec.wire
+                    label, "", [], self.scan_options, shard=wire
                 )
             except RPCError as e:
                 if "HTTP 404" in str(e):
@@ -527,7 +546,7 @@ class FleetCoordinator:
                     raise
             else:
                 return self._poll_result(i, shard, sub["JobID"], ctx)
-        resp = driver.scan_shard(label, shard.spec.wire, self.scan_options)
+        resp = driver.scan_shard(label, wire, self.scan_options)
         if shard.done:
             return None
         return resp

@@ -101,32 +101,22 @@ def _analysis_wire(option, scan_options) -> dict:
 # -- filesystem planning -----------------------------------------------------
 
 
-def _walk_units(root: str, option) -> tuple[list[tuple[str, list, int]], int, int]:
-    """One deterministic walk → directory-atomic units.
+def group_units(files: list[tuple[str, int]]) -> list[tuple[str, list, int]]:
+    """Directory-atomic unit grouping over ``[(rel, size), ...]``.
 
-    Returns ``(units, total_bytes, total_files)`` where each unit is
-    ``(unit_key, [(rel, size), ...], bytes)``. A directory containing
-    ``Chart.yaml`` pulls its whole subtree into one unit (Helm chart
-    evaluation reads the chart as a whole); every other directory is its
-    own unit (sibling files — manifest + lockfile pairs — stay together).
+    Each unit is ``(unit_key, [(rel, size), ...], bytes)``. A directory
+    containing ``Chart.yaml`` pulls its whole subtree into one unit (Helm
+    chart evaluation reads the chart as a whole); every other directory is
+    its own unit (sibling files — manifest + lockfile pairs — stay
+    together). Shared by the fleet shard planner AND the incremental-scan
+    unit planner (``trivy_tpu/incremental/fs.py``): both need an analysis
+    boundary that merges back byte-identically through the applier.
     """
-    from trivy_tpu.fanal.walker import FSWalker, WalkOption
-
-    walker = FSWalker(
-        WalkOption(
-            skip_files=list(getattr(option, "skip_files", [])),
-            skip_dirs=list(getattr(option, "skip_dirs", [])),
-        )
-    )
     by_dir: dict[str, list[tuple[str, int]]] = {}
     chart_roots: list[str] = []
-    total_bytes = 0
-    total_files = 0
-    for rel, info, _opener in walker.walk(root):
+    for rel, size in files:
         d = rel.rsplit("/", 1)[0] if "/" in rel else ""
-        by_dir.setdefault(d, []).append((rel, info.size))
-        total_bytes += info.size
-        total_files += 1
+        by_dir.setdefault(d, []).append((rel, size))
         if rel.rsplit("/", 1)[-1] == "Chart.yaml":
             chart_roots.append(d)
     # fold every directory under a chart root into that root's unit
@@ -142,13 +132,31 @@ def _walk_units(root: str, option) -> tuple[list[tuple[str, list, int]], int, in
         return d
 
     units_map: dict[str, list[tuple[str, int]]] = {}
-    for d, files in by_dir.items():
-        units_map.setdefault(unit_for(d), []).extend(files)
+    for d, entries in by_dir.items():
+        units_map.setdefault(unit_for(d), []).extend(entries)
     units = []
     for key in sorted(units_map):
-        files = sorted(units_map[key])
-        units.append((key, files, sum(s for _, s in files)))
-    return units, total_bytes, total_files
+        entries = sorted(units_map[key])
+        units.append((key, entries, sum(s for _, s in entries)))
+    return units
+
+
+def _walk_units(root: str, option) -> tuple[list[tuple[str, list, int]], int, int]:
+    """One deterministic walk → directory-atomic units (see
+    :func:`group_units`). Returns ``(units, total_bytes, total_files)``."""
+    from trivy_tpu.fanal.walker import FSWalker, WalkOption
+
+    walker = FSWalker(
+        WalkOption(
+            skip_files=list(getattr(option, "skip_files", [])),
+            skip_dirs=list(getattr(option, "skip_dirs", [])),
+        )
+    )
+    flat: list[tuple[str, int]] = []
+    for rel, info, _opener in walker.walk(root):
+        flat.append((rel, info.size))
+    units = group_units(flat)
+    return units, sum(s for _, s in flat), len(flat)
 
 
 def plan_fs_shards(root: str, option, scan_options,
@@ -324,6 +332,12 @@ def shard_artifact_option(shard: dict):
             "fleet scans with --secret-config require replicas to share "
             "the config file"
         )
+    # cross-replica dedup warming: the coordinator's warm hit-store
+    # entries ride the first shard to each replica; the secret analyzer
+    # seeds its scanner's store (namespace-mismatched entries drop loudly)
+    warm = shard.get("WarmHits")
+    if warm:
+        extra["secret_hit_seed"] = warm
     reg = shard.get("Registry") or {}
     return ArtifactOption(
         skip_files=list(shard.get("SkipFiles") or []),

@@ -1,0 +1,55 @@
+"""Incremental scanning (ROADMAP item 2): make re-scans near-no-ops.
+
+Production traffic at fleet scale is mostly re-scans of the same base
+images, vendored trees, and registries. This package turns cross-scan
+reuse into a first-class scenario on top of the content-addressed cache:
+
+- :mod:`~trivy_tpu.incremental.fs` — the unit-level incremental fs
+  artifact: the tree partitions into the SAME directory-atomic units the
+  fleet shard planner uses, each unit's blob is cached under a key derived
+  from its files' content hashes plus the full analysis fingerprint, and a
+  re-scan analyzes only units whose key is missing (everything else merges
+  out of the cache through the untouched applier path — findings
+  byte-identical to a full scan by the same construction the fleet merger
+  relies on);
+- :mod:`~trivy_tpu.incremental.manifest` — the scan manifest: per-file
+  content keys + stat signatures + the git commit, persisted through the
+  scan cache, so ``--since-last`` turns a repeat scan into a stat-walk
+  (no reads for unchanged files) and ``--diff-base <commit>`` trusts the
+  git tree diff instead of mtimes (CI checkouts have fresh mtimes);
+- diff-scan for images rides the existing ``MissingBlobs``/``layer_plan``
+  machinery: ``--diff-base <image-ref>`` pre-seeds the cache with the base
+  image's layers under the derived image's planned keys
+  (:func:`trivy_tpu.artifact.image.preseed_from_base`), so the scan
+  analyzes only layers absent from the base.
+
+Failure semantics: the cache is an accelerator, never a correctness
+dependency — a missing/unreadable manifest or a cold cache only means
+files get re-hashed / units get re-analyzed. Analysis boundaries are the
+fleet's (directory-atomic, Helm subtrees whole), so cross-directory
+post-analysis links (e.g. a Maven parent POM outside its module tree)
+share the fleet mode's documented caveat.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class IncrementalOptions:
+    """Resolved incremental-scan knobs (``--incremental`` /
+    ``--diff-base`` / ``--since-last``; watch mode forces since_last)."""
+
+    enabled: bool = False
+    diff_base: str = ""      # git commit-ish (fs/repo) — implies enabled
+    since_last: bool = False  # stat-manifest reuse — implies enabled
+
+    @classmethod
+    def from_opts(cls, opts: dict) -> "IncrementalOptions":
+        diff_base = str(opts.get("diff_base") or "")
+        since_last = bool(opts.get("since_last"))
+        enabled = bool(opts.get("incremental")) or bool(diff_base) or since_last
+        return cls(
+            enabled=enabled, diff_base=diff_base, since_last=since_last
+        )

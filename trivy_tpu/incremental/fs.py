@@ -1,0 +1,414 @@
+"""Unit-level incremental filesystem artifact.
+
+The single-blob fs artifact (``artifact/local_fs.py``) re-analyzes the
+whole tree every scan. This artifact partitions the tree into the SAME
+directory-atomic units the fleet shard planner produces
+(:func:`trivy_tpu.fleet.plan.group_units` — Helm chart subtrees whole,
+sibling manifest/lockfile pairs together), gives every unit its own blob
+keyed by **input content** (the unit's files' content hashes + the full
+analysis fingerprint), and analyzes only units whose key is missing from
+the cache. The ordinary applier merges the per-unit blobs, so findings
+are byte-identical to a full scan by the same construction the fleet
+merger relies on (path-disjoint blobs, deterministic sorted union).
+
+Re-scan ladder, cheapest first:
+
+1. ``--since-last``: a stat-walk — files whose ``(size, mtime_ns)``
+   matches the manifest reuse their recorded content key, NO read;
+2. ``--diff-base <commit>``: the git tree diff — files unchanged since
+   the manifest's commit reuse recorded keys even when every mtime is
+   fresh (CI checkouts);
+3. plain ``--incremental``: every file is re-hashed (one streaming read),
+   but unchanged units still skip analysis entirely — no chunking, no
+   device feed, no confirms.
+
+An unchanged tree therefore costs a walk plus (at most) hashing; the
+device pipeline never starts. That is the ≥10× warm re-scan win the
+``warm_rescan`` bench rep measures end to end.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+from trivy_tpu import log, obs
+from trivy_tpu.artifact.local_fs import DEFAULT_PARALLEL, ArtifactOption
+from trivy_tpu.cache.key import calc_key
+from trivy_tpu.fanal.analyzer import (
+    AnalyzerGroup,
+    AnalyzerOptions,
+    AnalysisResult,
+    note_file_skipped,
+)
+from trivy_tpu.fanal.handler import HandlerManager
+from trivy_tpu.fanal.walker import FSWalker, WalkOption
+from trivy_tpu.incremental import IncrementalOptions, manifest as manifest_mod
+from trivy_tpu.types import ArtifactReference
+
+logger = log.logger("incremental:fs")
+
+
+def _content_key(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+def _hash_file(path: str) -> str | None:
+    """Streaming content hash (bounded memory on huge files)."""
+    h = hashlib.blake2b(digest_size=16)
+    try:
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+    except OSError:
+        return None
+    return h.hexdigest()
+
+
+class IncrementalFSArtifact:
+    """Filesystem artifact with per-unit content-addressed blobs."""
+
+    type = "filesystem"
+
+    def __init__(self, root: str, cache, option: ArtifactOption | None = None,
+                 incremental: IncrementalOptions | None = None):
+        self.root = root
+        self.cache = cache
+        self.option = option or ArtifactOption()
+        self.incremental = incremental or IncrementalOptions(enabled=True)
+        self.group = AnalyzerGroup(
+            AnalyzerOptions(
+                disabled=self.option.disabled_analyzers,
+                secret_config_path=self.option.secret_config_path,
+                backend=self.option.backend,
+                root=root,
+                extra=self.option.analyzer_extra,
+            )
+        )
+        self.handlers = HandlerManager()
+        self.walker = FSWalker(
+            WalkOption(
+                skip_files=self.option.skip_files,
+                skip_dirs=self.option.skip_dirs,
+            )
+        )
+        # reuse accounting for tests, bench, and the watch-mode change
+        # detector: {units_total, units_analyzed, units_reused,
+        # files_stat_reused, files_git_reused, files_hashed, bytes_reused}
+        self.last_stats: dict = {}
+
+    # -- fingerprint ---------------------------------------------------------
+
+    def fingerprint(self) -> str:
+        """Digest of the FULL effective analysis config: analyzer + hook
+        versions, skip lists, the ``--secret-config`` file CONTENT, and
+        the misconfig knobs. Anything that can change findings must flip
+        this — a stale manifest/unit blob must be unreachable, never
+        served (the loud-miss discipline of the persistent dedup store)."""
+        secret_cfg_digest = ""
+        path = self.option.secret_config_path
+        if path and os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    secret_cfg_digest = hashlib.sha256(f.read()).hexdigest()
+            except OSError:
+                secret_cfg_digest = "unreadable"
+        extra = self.option.analyzer_extra or {}
+        doc = {
+            "v": manifest_mod.MANIFEST_VERSION,
+            "analyzers": self.group.versions(),
+            "hooks": self.handlers.versions(),
+            "skip_files": sorted(self.option.skip_files),
+            "skip_dirs": sorted(self.option.skip_dirs),
+            "secret_config": secret_cfg_digest,
+            "check_paths": sorted(extra.get("check_paths") or []),
+            "misconfig_scanners": sorted(extra.get("misconfig_scanners") or []),
+        }
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True, separators=(",", ":")).encode()
+        ).hexdigest()
+
+    def _unit_blob_key(self, unit: str, files: dict[str, str],
+                       fingerprint: str) -> str:
+        base = json.dumps(
+            {"incr_unit": unit, "files": files, "fp": fingerprint},
+            sort_keys=True, separators=(",", ":"),
+        )
+        return calc_key(
+            base,
+            analyzer_versions=self.group.versions(),
+            hook_versions=self.handlers.versions(),
+            skip_files=self.option.skip_files,
+            skip_dirs=self.option.skip_dirs,
+        )
+
+    # -- inspect -------------------------------------------------------------
+
+    def inspect(self) -> ArtifactReference:
+        ctx = obs.current()
+        progress = ctx.progress()
+        fingerprint = self.fingerprint()
+        root_abs = os.path.abspath(self.root)
+        incr = self.incremental
+
+        # 1. walk: collect (rel, info, mtime_ns, full) — stat only
+        entries: list[tuple] = []
+        for rel, info, _opener in self.walker.walk(self.root):
+            full = os.path.join(root_abs, *rel.split("/"))
+            try:
+                mtime_ns = os.lstat(full).st_mtime_ns
+            except OSError:
+                mtime_ns = -1
+            entries.append((rel, info, mtime_ns, full))
+            progress.note_walked(info.size)
+        progress.finish_walk()
+
+        # 2. prior manifest + git state for key reuse
+        manifest = manifest_mod.load_manifest(self.cache, root_abs, fingerprint)
+        man_files = (manifest or {}).get("Files") or {}
+        git_clean: set[str] | None = None  # rels unchanged vs the manifest
+        # the commit recorded in the manifest is CLEAN-only (see
+        # save_manifest): a manifest whose keys include uncommitted edits
+        # must never be git-reused — a later revert would make those paths
+        # "unchanged vs base" while their recorded keys hash the DIRTY
+        # content, serving stale findings
+        commit = manifest_mod.git_clean_head(root_abs)
+        if incr.diff_base:
+            base = manifest_mod.git_resolve(root_abs, incr.diff_base)
+            if manifest and manifest.get("Commit") == base:
+                changed = manifest_mod.git_changed_paths(root_abs, base)
+                git_clean = {e[0] for e in entries if e[0] not in changed}
+            else:
+                logger.warning(
+                    "--diff-base %s: no clean-worktree manifest recorded at "
+                    "that commit (have %s); falling back to content hashing",
+                    incr.diff_base,
+                    ((manifest or {}).get("Commit") or "none")[:12],
+                )
+
+        # 3. per-file content keys, cheapest source first
+        stat_reused = git_reused = hashed = 0
+        file_keys: dict[str, str] = {}
+        for rel, info, mtime_ns, full in entries:
+            rec = man_files.get(rel)
+            if (
+                incr.since_last and rec is not None
+                and rec[0] == info.size and rec[1] == mtime_ns
+                and mtime_ns >= 0
+            ):
+                file_keys[rel] = rec[2]
+                stat_reused += 1
+                continue
+            if git_clean is not None and rel in git_clean and rec is not None:
+                file_keys[rel] = rec[2]
+                git_reused += 1
+                continue
+            key = _hash_file(full)
+            if key is None:
+                # vanished between walk and hash (TOCTOU): drop the entry,
+                # count the skip once — same discipline as the single-host
+                # walk's read failures
+                note_file_skipped(rel, OSError("unreadable during hashing"))
+                file_keys[rel] = ""
+                continue
+            file_keys[rel] = key
+            hashed += 1
+        entries = [e for e in entries if file_keys.get(e[0])]
+
+        # 4. directory-atomic units + content-addressed unit keys
+        from trivy_tpu.fleet.plan import group_units
+
+        units = group_units([(rel, info.size) for rel, info, _, _ in entries])
+        by_rel = {rel: (info, mtime_ns, full)
+                  for rel, info, mtime_ns, full in entries}
+        unit_keys: dict[str, str] = {}
+        for unit, files, _nbytes in units:
+            unit_keys[unit] = self._unit_blob_key(
+                unit, {rel: file_keys[rel] for rel, _ in files}, fingerprint
+            )
+        blob_ids = [unit_keys[u] for u, _, _ in units]
+        artifact_id = calc_key(
+            json.dumps({"incr_root": root_abs, "units": blob_ids},
+                       sort_keys=True, separators=(",", ":")),
+        )
+
+        # 5. cache diff → dirty units only
+        if blob_ids:
+            _, missing = self.cache.missing_blobs(artifact_id, blob_ids)
+        else:
+            missing = []
+        missing_set = set(missing)
+        dirty = [(u, files, nbytes) for u, files, nbytes in units
+                 if unit_keys[u] in missing_set]
+        reused_bytes = sum(nbytes for u, _, nbytes in units
+                           if unit_keys[u] not in missing_set)
+        ctx.count("incr.units_reused", len(units) - len(dirty))
+        ctx.count("incr.bytes_reused", reused_bytes)
+        progress.note_scanned(reused_bytes)
+
+        if dirty:
+            self._analyze_units(dirty, by_rel, unit_keys, progress)
+
+        # 6. record the manifest for the next scan's stat-walk
+        manifest_mod.save_manifest(
+            self.cache, root_abs, fingerprint,
+            files={
+                rel: [info.size, mtime_ns, file_keys[rel]]
+                for rel, info, mtime_ns, _ in entries
+            },
+            units={u: unit_keys[u] for u, _, _ in units},
+            commit=commit,
+        )
+        self.last_stats = {
+            "unit_keys": tuple(blob_ids),
+            "units_total": len(units),
+            "units_analyzed": len(dirty),
+            "units_reused": len(units) - len(dirty),
+            "files_stat_reused": stat_reused,
+            "files_git_reused": git_reused,
+            "files_hashed": hashed,
+            "bytes_reused": reused_bytes,
+        }
+        logger.info(
+            "incremental scan of %s: %d/%d unit(s) reused "
+            "(%d stat-reused, %d git-reused, %d hashed file(s))",
+            self.root, len(units) - len(dirty), len(units),
+            stat_reused, git_reused, hashed,
+        )
+
+        name = self.root
+        if name != os.path.sep:
+            name = name.rstrip(os.path.sep)
+        return ArtifactReference(
+            name=name, type=self.type, id=artifact_id, blob_ids=blob_ids
+        )
+
+    # -- dirty-unit analysis -------------------------------------------------
+
+    def _analyze_units(self, dirty, by_rel, unit_keys, progress) -> None:
+        """One analyzer-group pass over every dirty unit's files, split
+        into per-unit blobs. Per-file analyzer output lands directly in
+        its unit's result (exact attribution, including OS identity from
+        os-release-style files); batch/post analyzer output is split by
+        file path — every batched item type is path-attributed."""
+        unit_of: dict[str, str] = {}
+        for unit, files, _ in dirty:
+            for rel, _size in files:
+                unit_of[rel] = unit
+        unit_results: dict[str, AnalysisResult] = {
+            unit: AnalysisResult() for unit, _, _ in dirty
+        }
+        post_files: dict = {}
+        tuning = (self.option.analyzer_extra or {}).get("tuning")
+        tuned_parallel = getattr(tuning, "parallel", 0) if tuning else 0
+        workers = self.option.parallel or tuned_parallel or DEFAULT_PARALLEL
+
+        def analyze(rel, fut):
+            # the walk's real FileInfo (size AND mode): executable-bit
+            # analyzers must see exactly what a full scan's walk passes
+            info, _mtime, _full = by_rel[rel]
+            try:
+                wanted = self.group.analyze_file(
+                    unit_results[unit_of[rel]], self.root, rel, info,
+                    fut.result,
+                )
+            except OSError as e:
+                note_file_skipped(rel, e)
+                progress.note_scanned(info.size)
+                return
+            for t, content in wanted.items():
+                post_files.setdefault(t, {})[rel] = content
+            progress.note_scanned(info.size)
+
+        try:
+            # bounded read-ahead window, same shape as the single-host walk
+            window: deque = deque()
+            buffered = 0
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                for unit, files, _nbytes in dirty:
+                    for rel, size in files:
+                        full = by_rel[rel][2]
+
+                        def opener(path=full) -> bytes:
+                            with open(path, "rb") as f:
+                                return f.read()
+
+                        window.append((rel, pool.submit(opener)))
+                        buffered += size
+                        while buffered > (64 << 20) or len(window) > 128:
+                            r, fut = window.popleft()
+                            buffered -= by_rel[r][0].size
+                            analyze(r, fut)
+                while window:
+                    r, fut = window.popleft()
+                    analyze(r, fut)
+            # batch (device) + post analyzers finalize ONCE for the whole
+            # dirty set — their items split by path below
+            batch_result = AnalysisResult()
+            self.group.finalize(batch_result, post_files)
+        except BaseException:
+            self.group.abort()
+            raise
+        self._split_batch_result(batch_result, unit_of, unit_results, dirty)
+
+        for unit, _files, _nbytes in dirty:
+            result = unit_results[unit]
+            blob = result.to_blob_info()
+            self.handlers.post_handle(result, blob)
+            self.cache.put_blob(unit_keys[unit], blob.to_dict())
+
+    def _split_batch_result(self, batch: AnalysisResult, unit_of: dict,
+                            unit_results: dict, dirty) -> None:
+        first_unit = min(u for u, _, _ in dirty)
+
+        def target(path: str) -> AnalysisResult | None:
+            return unit_results.get(unit_of.get(path, ""))
+
+        for item_list, attr in (
+            (batch.package_infos, "package_infos"),
+            (batch.applications, "applications"),
+            (batch.misconfigurations, "misconfigurations"),
+            (batch.secrets, "secrets"),
+            (batch.licenses, "licenses"),
+            (batch.custom_resources, "custom_resources"),
+        ):
+            for item in item_list:
+                r = target(item.file_path)
+                if r is None:
+                    # a batched item for a path outside the dirty set
+                    # cannot happen by construction; keep it loudly rather
+                    # than dropping a finding
+                    logger.warning(
+                        "batched %s finding for unplanned path %s kept in "
+                        "unit %r", attr, item.file_path, first_unit,
+                    )
+                    r = unit_results[first_unit]
+                getattr(r, attr).append(item)
+        for path, digest in (batch.digests or {}).items():
+            r = target(path) or unit_results[first_unit]
+            r.digests[path] = digest
+        for path in batch.system_files:
+            r = target(path) or unit_results[first_unit]
+            r.system_files.append(path)
+        # non-path-attributed fields are only ever produced by PER-FILE
+        # analyzers (os-release, apk-repo, buildinfo), which landed in
+        # their unit's result directly; a batched one would be a new
+        # analyzer contract violation — keep it deterministic and loud
+        if batch.os or batch.repository or batch.build_info:
+            logger.warning(
+                "batched analyzer produced non-path-attributed state; "
+                "folding into unit %r (incremental split cannot attribute "
+                "it)", first_unit,
+            )
+            unit_results[first_unit].merge(
+                AnalysisResult(
+                    os=batch.os, repository=batch.repository,
+                    build_info=batch.build_info,
+                )
+            )

@@ -1,0 +1,145 @@
+"""Scan manifest: the record that turns a repeat scan into a stat-walk.
+
+One manifest per (root, analysis fingerprint), persisted through the scan
+cache's artifact table (any backend — fs, redis, memory). It records, for
+every walked file, the stat signature ``(size, mtime_ns)`` and the content
+key the last scan computed, plus the git commit the tree was at (when the
+root is a git worktree) and the unit → blob-id map.
+
+``--since-last`` reuses a recorded content key when the stat signature
+matches (no read, no hash); ``--diff-base <commit>`` reuses recorded keys
+for files the git tree diff says are unchanged since the manifest's
+commit, which survives fresh checkouts where every mtime is new.
+
+The manifest is invalidated as a whole by the analysis fingerprint in its
+storage key — a rule-file edit, analyzer-version bump, or skip-list change
+makes the old manifest unreachable by construction (the loud-miss
+discipline the persistent dedup store shares).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import subprocess
+import time
+
+from trivy_tpu import log
+
+logger = log.logger("incremental:manifest")
+
+MANIFEST_VERSION = 1
+
+
+def manifest_key(root: str, fingerprint: str) -> str:
+    digest = hashlib.sha256(f"{root}|{fingerprint}".encode()).hexdigest()
+    return f"incr-manifest:{digest}"
+
+
+def load_manifest(cache, root: str, fingerprint: str) -> dict | None:
+    try:
+        doc = cache.get_artifact(manifest_key(root, fingerprint))
+    except Exception as e:
+        logger.warning("manifest load failed (%s); scanning without it", e)
+        return None
+    if not isinstance(doc, dict) or doc.get("Version") != MANIFEST_VERSION:
+        if doc is not None:
+            logger.warning(
+                "manifest for %s has version %r (want %d); ignoring it",
+                root, (doc or {}).get("Version"), MANIFEST_VERSION,
+            )
+        return None
+    return doc
+
+
+def save_manifest(
+    cache, root: str, fingerprint: str,
+    files: dict[str, list], units: dict[str, str],
+    commit: str = "",
+) -> dict:
+    doc = {
+        "Version": MANIFEST_VERSION,
+        "Root": root,
+        "Fingerprint": fingerprint,
+        "Commit": commit,
+        "Files": files,   # rel -> [size, mtime_ns, content_key]
+        "Units": units,   # unit path -> blob id
+        "CreatedWall": time.time(),
+    }
+    try:
+        cache.put_artifact(manifest_key(root, fingerprint), doc)
+    except Exception as e:
+        logger.warning("manifest save failed (%s); next scan runs cold", e)
+    return doc
+
+
+# -- git helpers (diff-base) --------------------------------------------------
+
+
+class GitDiffError(RuntimeError):
+    pass
+
+
+def _git(root: str, args: list[str]) -> str:
+    try:
+        proc = subprocess.run(
+            ["git", *args], cwd=root, capture_output=True, text=True,
+            timeout=120,
+        )
+    except FileNotFoundError as e:
+        raise GitDiffError("git is not installed") from e
+    except subprocess.TimeoutExpired as e:
+        raise GitDiffError(f"git {args[0]} timed out") from e
+    if proc.returncode != 0:
+        raise GitDiffError(
+            f"git {' '.join(args[:2])} failed: {proc.stderr.strip()[:300]}"
+        )
+    return proc.stdout
+
+
+def git_head(root: str) -> str:
+    """HEAD commit id, or "" when the root is not a git worktree."""
+    try:
+        return _git(root, ["rev-parse", "HEAD"]).strip()
+    except GitDiffError:
+        return ""
+
+
+def git_clean_head(root: str) -> str:
+    """HEAD commit id IF the worktree is clean (no staged/unstaged/
+    untracked changes), else "". The manifest records only clean-worktree
+    commits: content keys hashed over dirty files must never be reachable
+    through a later ``--diff-base`` tree diff (a revert would mark them
+    unchanged while the recorded keys cover the dirty bytes)."""
+    head = git_head(root)
+    if not head:
+        return ""
+    try:
+        dirty = _git(root, ["status", "--porcelain", "--no-renames"]).strip()
+    except GitDiffError:
+        return ""
+    return "" if dirty else head
+
+
+def git_resolve(root: str, ref: str) -> str:
+    """Resolve a commit-ish to a full id (raises GitDiffError loudly —
+    a typoed ``--diff-base`` must not silently full-scan)."""
+    return _git(root, ["rev-parse", "--verify", f"{ref}^{{commit}}"]).strip()
+
+
+def git_changed_paths(root: str, base: str) -> set[str]:
+    """Paths changed between ``base`` and the CURRENT worktree: committed
+    changes (tree diff base..HEAD), staged/unstaged edits, and untracked
+    files. Renames are reported as delete+add (--no-renames) so both sides
+    re-key. Paths are repo-root-relative posix, matching the walker."""
+    changed: set[str] = set()
+    out = _git(
+        root,
+        ["diff", "--name-only", "--no-renames", "-z", base, "HEAD"],
+    )
+    changed.update(p for p in out.split("\0") if p)
+    # worktree state on top of HEAD: modified, staged, and untracked files
+    out = _git(root, ["status", "--porcelain", "--no-renames", "-z"])
+    for entry in out.split("\0"):
+        if len(entry) > 3:
+            changed.add(entry[3:])
+    return changed

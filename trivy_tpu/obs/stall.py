@@ -27,6 +27,8 @@ from trivy_tpu.obs import TraceContext
 # trailing stage-name component -> attribution bucket
 BUCKETS = {
     "queue_wait": "queue-bound",  # admission-queue wait before the scan ran
+    "warm_hit": "warm-hit",  # batched persistent dedup-store lookups —
+    # a warm re-scan's time goes here instead of upload/device buckets
     "feed_wait": "feed-starved",
     "dispatch": "upload-bound",
     "device_wait": "device-bound",
@@ -41,6 +43,7 @@ BUCKETS = {
 # stable display order for verdict lines
 ORDER = [
     "queue-bound",
+    "warm-hit",
     "feed-starved",
     "upload-bound",
     "device-bound",

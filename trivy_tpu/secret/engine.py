@@ -61,6 +61,9 @@ class ScannerConfig:
     disable_rule_ids: list[str] = field(default_factory=list)
     disable_allow_rule_ids: list[str] = field(default_factory=list)
     exclude_block_regexes: list[str] = field(default_factory=list)
+    # sha256 of the source --secret-config file bytes ("" when built
+    # programmatically): folded into persistent dedup/manifest namespaces
+    source_digest: str = ""
 
     @classmethod
     def from_dict(cls, d: dict) -> "ScannerConfig":
@@ -106,10 +109,21 @@ class ScannerConfig:
 
     @classmethod
     def from_yaml_file(cls, path: str) -> "ScannerConfig":
+        import hashlib
+
         import yaml  # baked in via transformers' dependency set
 
-        with open(path, "r", encoding="utf-8") as f:
-            return cls.from_dict(yaml.safe_load(f) or {})
+        with open(path, "rb") as f:
+            raw = f.read()
+        cfg = cls.from_dict(yaml.safe_load(raw.decode("utf-8")) or {})
+        # content digest of the config FILE: the persistent dedup store and
+        # the incremental-scan manifest fold this into their namespace keys,
+        # so editing the rule file invalidates every persisted entry even
+        # when the parsed rule set happens to hash the same way (allow
+        # rules, exclude blocks, and disables don't change hit vectors but
+        # DO change findings)
+        cfg.source_digest = hashlib.sha256(raw).hexdigest()
+        return cfg
 
 
 class SecretScanner:

@@ -92,7 +92,7 @@ import os
 import queue
 import threading
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from collections.abc import Iterable, Iterator
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -140,12 +140,16 @@ SINGLE_DEVICE_STREAMS = 4
 # workers for exact host confirmation (overlaps device-result waits)
 CONFIRM_WORKERS = 4
 # bounded in-process LRU for the chunk-dedup hit cache; most entries are an
-# empty tuple (clean chunk), so 64k entries cost a few MB
+# empty tuple (clean chunk), so 64k entries cost a few MB. The REAL bound
+# is now the byte budget (hitstore.DEFAULT_STORE_MB / --secret-dedup-mb);
+# this entry count stays as a backstop
 HIT_CACHE_ENTRIES = 1 << 16
 # bump when device-compile semantics change in a way that alters hit
 # vectors for identical (rules, chunk) inputs — invalidates persisted caches
-# (v2: values grew prefilter candidate masks + nfa/license flags)
-HIT_CACHE_VERSION = 2
+# (v2: values grew prefilter candidate masks + nfa/license flags;
+# v3: the fingerprint folds the --secret-config file content and persisted
+# lookups/writes are batched through secret/hitstore.py)
+HIT_CACHE_VERSION = 3
 # re-dispatches allowed per failed batch before the failure escalates to
 # the scan-level fallback ladder (OOM-shaped splits don't consume this
 # budget: halving strictly shrinks the batch, so it terminates on its own)
@@ -219,6 +223,8 @@ class ScanStats:
         "batch_retries",     # failed batches re-dispatched whole
         "batch_splits",      # OOM-shaped failures answered by halving
         "degraded",          # scans that fell back to the exact host path
+        "chunks_warm_hit",   # rows served from the PERSISTENT store
+        "bytes_warm_hit",    # corpus bytes those rows covered
         "rows_prefiltered",  # rows the keyword prefilter pass inspected
         "rows_prefilter_hit",  # rows with >=1 candidate rule
         "rows_nfa_skipped",  # rows whose batch skipped the anchored kernel
@@ -278,6 +284,8 @@ class TpuSecretScanner:
         # passes the result here; library callers stay hermetic)
         arena_slabs: int = 0,  # chunk-arena slab override; 0 = derived
         bucket_rungs: int = 0,  # dispatch bucket-ladder depth; 0 = default
+        hit_cache_bytes: int = 0,  # dedup LRU byte budget; 0 = tuning's
+        # dedup_store_mb (default hitstore.DEFAULT_STORE_MB)
     ):
         import jax
 
@@ -386,6 +394,13 @@ class TpuSecretScanner:
         for r in self.exact.rules:
             fp.update(repr((r.id, r.regex, r.keywords, r.path)).encode())
             fp.update(b"\x00")
+        # the FULL effective config: the --secret-config file's content
+        # digest (allow rules / exclude blocks / disables change findings
+        # without changing hit vectors, and a persisted manifest keyed on
+        # this fingerprint caches findings) — a changed rule file flips
+        # every persisted namespace, loudly (hitstore namespace marker)
+        fp.update(b"cfg:")
+        fp.update(getattr(config, "source_digest", "").encode() or b"-")
         # prefilter table fingerprint: cached vectors now carry candidate
         # masks derived from the keyword table, so a keyword add/remove/edit
         # — or toggling the prefilter itself, which changes the cached value
@@ -398,10 +413,21 @@ class TpuSecretScanner:
         self.ruleset_fingerprint = fp.digest()
         self._dedup = dedup
         self._pack_small = pack_small
-        self._hit_lru: OrderedDict[bytes, tuple] = OrderedDict()
-        self._hit_lru_max = hit_cache_entries
-        self._hit_lock = threading.Lock()
-        self._hit_persist = hit_cache
+        # persistent cross-scan dedup store (secret/hitstore.py): the
+        # in-process LRU is BYTE-bounded (--secret-dedup-mb, entry count
+        # as a backstop) so streaming multi-GB scans keep flat RSS, and
+        # backend lookups/writes are batched per assembled/resolved batch
+        from trivy_tpu.secret.hitstore import DEFAULT_STORE_MB, HitStore
+
+        store_bytes = hit_cache_bytes or (
+            (tuning.dedup_store_mb or DEFAULT_STORE_MB) << 20
+        )
+        self._hit_store = HitStore(
+            self.ruleset_fingerprint,
+            backend=hit_cache,
+            max_entries=hit_cache_entries,
+            max_bytes=store_bytes,
+        )
         self._host_fallback = host_fallback
         self._batch_retries = batch_retries
         self.stats = ScanStats()
@@ -510,58 +536,41 @@ class TpuSecretScanner:
     # the prefilter table) plus a ':lic' namespace when a license gate is
     # active, so entries can never cross modes.
 
-    def _persist_key(self, key: bytes) -> str:
-        return f"secret-hitv2:{self.ruleset_fingerprint.hex()}:{key.hex()}"
+    @property
+    def _hit_lru(self):
+        # introspection surface for tests/bench (entry count, reuse proofs)
+        return self._hit_store._lru
+
+    @property
+    def _hit_persist(self):
+        return self._hit_store.backend
 
     def _hit_get(self, key: bytes):
-        """Cached row verdict for a row digest, or None."""
-        with self._hit_lock:
-            v = self._hit_lru.get(key)
-            if v is not None:
-                self._hit_lru.move_to_end(key)
-                return v
-        if self._hit_persist is not None:
-            blob = self._hit_persist.get_blob(self._persist_key(key))
-            if blob is not None:
-                lic = blob.get("l")
-                v = (
-                    tuple(blob["r"]),
-                    tuple(blob.get("c", ())),
-                    bool(blob.get("n", 1)),
-                    None if lic is None else tuple(lic),
-                )
-                self._lru_insert(key, v)
-                return v
-        return None
+        """Cached row verdict for a row digest from the IN-PROCESS LRU, or
+        None. Persistent-store lookups are batched at slab-flush time
+        (one pipelined round trip per batch — see ``_ScanRun._feed``)."""
+        return self._hit_store.get(key)
 
     def clear_hit_cache(self) -> None:
         """Drop the in-process hit LRU (persisted entries are untouched) —
         used by bench to measure the cold feed path."""
-        with self._hit_lock:
-            self._hit_lru.clear()
-
-    def _lru_insert(self, key: bytes, verdict) -> None:
-        """Insert under the entry bound — every LRU write path must evict,
-        or persisted-cache re-scans of large corpora grow RSS unboundedly."""
-        with self._hit_lock:
-            self._hit_lru[key] = verdict
-            self._hit_lru.move_to_end(key)
-            while len(self._hit_lru) > self._hit_lru_max:
-                self._hit_lru.popitem(last=False)
+        self._hit_store.clear_local()
 
     def _hit_put(self, key: bytes, verdict) -> None:
-        self._lru_insert(key, verdict)
-        if self._hit_persist is not None:
-            hit_rules, cand_rules, nfa_ran, lic = verdict
-            self._hit_persist.put_blob(
-                self._persist_key(key),
-                {
-                    "r": list(hit_rules),
-                    "c": list(cand_rules),
-                    "n": int(nfa_ran),
-                    "l": lic if lic is None else list(lic),
-                },
-            )
+        self._hit_store.put(key, verdict)
+
+    def seed_hit_entries(self, entries: list) -> int:
+        """Pre-warm the dedup store from a peer's export (fleet
+        cross-replica warming); returns entries accepted. Entries from a
+        different fingerprint namespace are dropped loudly in the store."""
+        return self._hit_store.seed(entries)
+
+    def export_warm_hits(self, limit: int = 0) -> list:
+        """Warm dedup entries (``[[persist_key, doc], ...]``) for peer
+        seeding."""
+        from trivy_tpu.secret.hitstore import WARM_EXPORT_LIMIT
+
+        return self._hit_store.export_warm(limit or WARM_EXPORT_LIMIT)
 
     # -- async feed pipeline ------------------------------------------------
 
@@ -955,6 +964,9 @@ class _ScanRun:
         for w in self.workers:
             w.join(timeout=10.0)
         self.pool.shutdown(wait=False)
+        # push the dedup store's write-behind tail (one final round trip)
+        # so the NEXT scan — possibly another process — starts warm
+        self.sc._hit_store.flush_writes(force=True)
         # slabs still parked in the dispatch queue after an early close
         while True:
             try:
@@ -1245,7 +1257,7 @@ class _ScanRun:
                 license_rows_flagged=int(lic_arr.any(axis=1).sum()),
             )
         apply: list = []
-        for row, (key, segs) in enumerate(batch_meta):
+        for row, (key, segs, _) in enumerate(batch_meta):
             hit_rules = tuple(by_row.get(row, ()))
             cand_rules = tuple(cand_by_row.get(row, ()))
             lic = lic_by_row.get(row, ()) if lic_ran else None
@@ -1257,6 +1269,9 @@ class _ScanRun:
                     waiting = self.row_waiters.pop(key, ())
                 for w in waiting:
                     apply.append((w,) + verdict)
+        # write-behind flush: one pipelined backend round trip per batch
+        # (no-op without a persistent backend / below the batch threshold)
+        self.sc._hit_store.flush_writes()
         self._apply_hits(apply)
 
     # -- transfer-stream workers --------------------------------------------
@@ -1357,7 +1372,7 @@ class _ScanRun:
                 return False
             lp = self.lic_paths
             return any(
-                fidx in lp for _, segs in meta for fidx, _, _ in segs
+                fidx in lp for _, segs, _ in meta for fidx, _, _ in segs
             )
 
         def dispatch_batch(batch, meta, slab_id, retries) -> None:
@@ -1570,11 +1585,13 @@ class _ScanRun:
             if lic_gate is not None and lic_gate.wants(path):
                 lic_gate.skip(path)
 
+        persist_on = dedup and sc._hit_store.backend is not None
         slab_id: int | None = None
         slab: np.ndarray | None = None
         used = 0
-        # per-row feed metadata: (digest | None, [(fidx, win_start, win_end)])
-        meta: list[tuple[bytes | None, list[tuple[int, int, int]]]] = []
+        # per-row feed metadata:
+        # (digest | None, [(fidx, win_start, win_end)], corpus_bytes)
+        meta: list[tuple[bytes | None, list[tuple[int, int, int]], int]] = []
         # slab rows awaiting the bulk strided gather from the current file
         copy_rows: list[int] = []
         copy_starts: list[int] = []
@@ -1641,11 +1658,73 @@ class _ScanRun:
                     ctx.count("secret.bytes_dedup_hit", nbytes)
             return coalesced
 
+        def warm_filter() -> None:
+            """Persistent-store lookup for the assembled slab's rows: ONE
+            pipelined backend round trip per batch (never per row). Rows
+            whose verdict is already persisted resolve right here — no
+            upload, no kernel — and the slab compacts over the survivors
+            with one vectorized gather."""
+            nonlocal meta
+            keys = [k for k, _, _ in meta if k is not None]
+            if not keys:
+                return
+            with ctx.span("secret.warm_hit"):
+                found = sc._hit_store.lookup_batch(keys)
+            if not found:
+                return
+            live: list[int] = []
+            warm_apply: list = []
+            warm_rows = 0
+            warm_bytes = 0
+            for i, (k, segs, nbytes) in enumerate(meta):
+                v = found.get(k) if k is not None else None
+                if v is None:
+                    live.append(i)
+                    continue
+                warm_rows += 1
+                warm_bytes += nbytes
+                warm_apply.append((segs,) + v)
+                with self.lock:
+                    waiting = self.row_waiters.pop(k, ())
+                for w in waiting:
+                    warm_apply.append((w,) + v)
+            if not warm_apply:
+                return
+            # chunks_uploaded was counted at assembly; correct it so the
+            # dedup-hit-rate denominators stay exact
+            stats.add(
+                chunks_dedup_hit=warm_rows, bytes_dedup_hit=warm_bytes,
+                chunks_warm_hit=warm_rows, bytes_warm_hit=warm_bytes,
+                chunks_uploaded=-warm_rows,
+            )
+            if enabled:
+                ctx.count("secret.bytes_dedup_hit", warm_bytes)
+                ctx.count("secret.bytes_warm_hit", warm_bytes)
+            self._apply_hits(warm_apply)
+            if live:
+                slab[: len(live)] = slab[np.asarray(live)]
+            meta = [meta[i] for i in live]
+
         def flush() -> None:
             nonlocal slab_id, slab, used, meta
             flush_copies()
+            if persist_on and meta:
+                warm_filter()
             if not meta:
-                return  # empty slab: padding-only batches are never sent
+                # empty slab: padding-only batches are never sent (and a
+                # fully-warm slab resolved above with no upload at all)
+                if slab is not None:
+                    self.arena.release(slab_id)
+                    slab_id = None
+                    slab = None
+                    used = 0
+                # a fully-warm flush is still a batch boundary: the pack
+                # staleness bound below must hold on warm streaming scans
+                # too, or a lone small file would stall in-order emission
+                # until end-of-input
+                if pack_pending:
+                    emit_pack()
+                return
             n = next(b for b in sc._buckets if b >= len(meta))
             stats.add(bytes_uploaded=n * chunk_len)
             if enabled:
@@ -1704,7 +1783,7 @@ class _ScanRun:
             for _, d in items:
                 row[off : off + len(d)] = np.frombuffer(d, dtype=np.uint8)
                 off += len(d) + gap
-            meta.append((key, segs))
+            meta.append((key, segs, nbytes))
             used += 1
             stats.add(chunks_uploaded=1)
             if len(segs) > 1:
@@ -1763,7 +1842,7 @@ class _ScanRun:
                     # short tail row: copy, then zero the stale remainder
                     slab[used, : end - s] = arr[s:end]
                     slab[used, end - s :] = 0
-                meta.append((key, segs))
+                meta.append((key, segs, end - s))
                 used += 1
                 uploaded += 1
                 if used == B:

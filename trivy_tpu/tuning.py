@@ -64,7 +64,7 @@ DEFAULT_TUNING_INTERVAL = 0.5
 # knobs TuningConfig owns; order is the canonical display/serialize order
 KNOBS = (
     "feed_streams", "inflight", "arena_slabs", "bucket_rungs", "parallel",
-    "fleet_inflight",
+    "fleet_inflight", "dedup_store_mb",
 )
 
 # env spellings per knob (the feed-path pair predates this module and is
@@ -76,6 +76,7 @@ _ENV_NAMES = {
     "bucket_rungs": "TRIVY_TPU_BUCKET_RUNGS",
     "parallel": "TRIVY_TPU_PARALLEL",
     "fleet_inflight": "TRIVY_TPU_FLEET_INFLIGHT",
+    "dedup_store_mb": "TRIVY_TPU_DEDUP_STORE_MB",
 }
 
 
@@ -126,6 +127,7 @@ class TuningConfig:
     bucket_rungs: int = 0   # dispatch bucket-ladder depth (0 = default: 3)
     parallel: int = 0       # host read/analyze workers (0 = DEFAULT_PARALLEL)
     fleet_inflight: int = 0  # shard jobs in flight per fleet replica (0 = 2)
+    dedup_store_mb: int = 0  # dedup hit-store LRU byte budget (0 = 32 MB)
     controller: bool = False          # online mid-scan adaptation
     tuning_interval: float = DEFAULT_TUNING_INTERVAL
     topology: str = ""                # fingerprint this config resolved for
@@ -141,6 +143,7 @@ class TuningConfig:
             "bucket_rungs": self.bucket_rungs,
             "parallel": self.parallel,
             "fleet_inflight": self.fleet_inflight,
+            "dedup_store_mb": self.dedup_store_mb,
             "controller": self.controller,
             "tuning_interval": self.tuning_interval,
             "topology": self.topology,
@@ -268,6 +271,7 @@ def resolve_tuning(opts: dict | None = None, env: dict | None = None,
         "bucket_rungs": "secret_bucket_rungs",
         "parallel": "parallel",
         "fleet_inflight": "fleet_inflight",
+        "dedup_store_mb": "secret_dedup_mb",
     }
     if autotune_path is None:
         autotune_path = opts.get("tuning_file") or env.get(ENV_TUNING_FILE)
