@@ -57,7 +57,8 @@ def _shared_scanner(
         tuning_key = (
             tuning.feed_streams, tuning.inflight, tuning.arena_slabs,
             tuning.bucket_rungs, tuning.controller, tuning.tuning_interval,
-            tuning.dedup_store_mb,
+            tuning.dedup_store_mb, tuning.compress,
+            tuning.compress_min_ratio,
         )
     key = (
         id(config) if config is not None else None,

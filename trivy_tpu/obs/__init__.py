@@ -231,6 +231,11 @@ class TraceContext:
         # controller is on) — tuning_doc() merges both for export
         self.tuning: dict | None = None
         self.tuning_controller = None
+        # compressed-feed wire accounting (trivy_tpu/secret/compress.py):
+        # run-level compression ratio + byte counters, set by the scan run
+        # on close when the codec is active; None on uncompressed scans so
+        # exports show no empty block
+        self.wire: dict | None = None
         # always-on scan progress (bytes/files walked vs scanned), created
         # lazily by progress() — like health, NOT gated on `enabled`
         self._progress = None
@@ -459,6 +464,7 @@ class TraceContext:
             self.timeseries = None
             self.tuning = None
             self.tuning_controller = None
+            self.wire = None
 
     # -- aggregation --------------------------------------------------------
 

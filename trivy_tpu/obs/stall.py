@@ -31,6 +31,8 @@ BUCKETS = {
     # a warm re-scan's time goes here instead of upload/device buckets
     "feed_wait": "feed-starved",
     "dispatch": "upload-bound",
+    "compress": "codec-bound",  # host-side slab encode (compressed feed)
+    "decompress": "codec-bound",  # wire-frame placement + decode launch
     "device_wait": "device-bound",
     "prefilter": "device-bound",  # blocking prefilter-result fetch
     "confirm": "confirm-bound",
@@ -46,6 +48,7 @@ ORDER = [
     "warm-hit",
     "feed-starved",
     "upload-bound",
+    "codec-bound",
     "device-bound",
     "confirm-bound",
     "parse-bound",

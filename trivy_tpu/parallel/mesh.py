@@ -532,8 +532,51 @@ class StagedDispatch:
         self.busy.begin(None)
         return dev, None
 
+    def put_parts(self, arrays: tuple):
+        """Pad-free multi-array placement: ship a tuple of host arrays to
+        ONE device as a unit — the compressed-slab wire frame (flat byte
+        buffer + per-row offs/clen/mode), whose decompress stage expands
+        them into the resident ``[B, C]`` rows the other stages read.
+        Same breaker/fault/span ladder as :meth:`put`; the caller owns
+        shape discipline (arrays are shipped exactly as given). The mesh
+        flavor is unsupported — a flat wire buffer has no row axis to
+        shard, so the scanner gates compression off under a mesh."""
+        if self.mesh is not None:
+            raise ValueError(
+                "put_parts: compressed frames cannot shard over a mesh"
+            )
+        if self.devices:
+            with self._lock:
+                i = self.breaker.next_device(self._next)
+                if i is None:
+                    raise DevicesUnavailable(
+                        f"all {len(self.devices)} dispatch devices are "
+                        f"circuit-broken"
+                    )
+                self._next = (i + 1) % len(self.devices)
+            try:
+                faults.check("device.dispatch", key=f"d{i}")
+                with obs.current().span(f"mesh.d{i}.dispatch"):
+                    dev = tuple(
+                        jax.device_put(a, self.devices[i]) for a in arrays
+                    )
+            except Exception:
+                self.breaker.record_failure(i)
+                raise
+            obs.current().count(f"mesh.d{i}.batches")
+            self.busy.begin(i)
+            return dev, i
+        faults.check("device.dispatch", key="d0")
+        dev = tuple(jax.device_put(a) for a in arrays)
+        self.busy.begin(None)
+        return dev, None
+
     def run(self, name: str, dev, device_idx=None):
-        """Launch stage ``name`` on an already-resident batch (async)."""
+        """Launch stage ``name`` on an already-resident batch (async).
+        ``dev`` may be a tuple (a :meth:`put_parts` frame) — the stage is
+        then called with the parts as positional args."""
+        if isinstance(dev, tuple):
+            return self._stages[name](*dev)
         return self._stages[name](dev)
 
     def record_result(self, i, ok: bool) -> None:

@@ -101,6 +101,7 @@ import numpy as np
 
 from trivy_tpu import faults, log, obs
 from trivy_tpu.ops.match import build_match_fn
+from trivy_tpu.secret.compress import COMPRESS_MIN_RATIO, CompressedSlab
 from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
 from trivy_tpu.secret.feed import ChunkArena, row_windows
 from trivy_tpu.secret.engine import ScannerConfig, SecretScanner
@@ -148,8 +149,13 @@ HIT_CACHE_ENTRIES = 1 << 16
 # vectors for identical (rules, chunk) inputs — invalidates persisted caches
 # (v2: values grew prefilter candidate masks + nfa/license flags;
 # v3: the fingerprint folds the --secret-config file content and persisted
-# lookups/writes are batched through secret/hitstore.py)
-HIT_CACHE_VERSION = 3
+# lookups/writes are batched through secret/hitstore.py;
+# v4: compressed slab wire format — rows may now reach the kernels through
+# the device decompressor, whose output must be byte-identical to a raw
+# upload; the bump invalidates stores written by builds without that
+# parity guarantee. Keys still hash UNCOMPRESSED row content, so entries
+# stay codec-invariant: toggling --secret-compress never flips a key)
+HIT_CACHE_VERSION = 4
 # re-dispatches allowed per failed batch before the failure escalates to
 # the scan-level fallback ladder (OOM-shaped splits don't consume this
 # budget: halving strictly shrinks the batch, so it terminates on its own)
@@ -231,6 +237,22 @@ class ScanStats:
         "batches_nfa_skipped",  # batches resolved by the prefilter alone
         "license_rows_gated",    # arena rows the license gram gate read
         "license_rows_flagged",  # rows that flagged a license candidate
+        # compressed wire format (secret/compress.py): bytes_uploaded above
+        # always counts ACTUAL link traffic (compressed wire + framing when
+        # a batch ships compressed); bytes_raw_equiv is what those batches
+        # would have cost raw, so ratio = uploaded/raw_equiv-side math
+        # needs no second bookkeeping path
+        "bytes_compressed",      # wire+framing bytes of compressed batches
+        "bytes_raw_equiv",       # raw padded bytes those batches replaced
+        "bytes_raw_fallback",    # padded bytes shipped raw (didn't pay /
+                                 # codec error / binary-heavy batch)
+        "bytes_gated",           # corpus bytes the zero gate kept off the
+                                 # link (all-zero rows resolve via dedup)
+        "bytes_gated_binary",    # raw bytes of binary rows shipped RAW
+                                 # inside compressed frames
+        "chunks_gated_zero",     # rows the zero gate resolved
+        "batches_compressed",    # batches that shipped compressed
+        "batches_raw_fallback",  # batches that fell back to raw slabs
     )
 
     def __init__(self):
@@ -286,6 +308,12 @@ class TpuSecretScanner:
         bucket_rungs: int = 0,  # dispatch bucket-ladder depth; 0 = default
         hit_cache_bytes: int = 0,  # dedup LRU byte budget; 0 = tuning's
         # dedup_store_mb (default hitstore.DEFAULT_STORE_MB)
+        compress: str = "",  # compressed slab wire format: 'auto' (on for
+        # real accelerator links, off on the host backend and under a
+        # mesh), 'on', 'off'; "" = tuning's --secret-compress resolution
+        compress_min_ratio: float = 0.0,  # per-batch wire budget as a
+        # fraction of the raw slab — a batch that can't compress below
+        # this ships raw; 0 = tuning / COMPRESS_MIN_RATIO default
     ):
         import jax
 
@@ -518,6 +546,73 @@ class TpuSecretScanner:
             buckets.append(buckets[-1] // 2)
         self._buckets = sorted(buckets)
 
+        # -- compressed slab wire format (secret/compress.py) ---------------
+        # 'auto' opts in only where compression can pay: a real accelerator
+        # link (the CPU backend shares one memory bus — compressing for it
+        # only burns host cycles) and no mesh (a flat wire buffer has no
+        # row axis to shard_map over). Zero-cost-when-off bar: an 'off'
+        # scanner builds no codec tables, registers no decompress stage,
+        # and allocates no wire-rung state — bench --smoke asserts this.
+        from trivy_tpu.parallel.mesh import link_class
+
+        mode = compress or tuning.compress or "auto"
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"compress={mode!r}: use 'auto', 'on', or 'off'"
+            )
+        comp_on = mode == "on" or (
+            mode == "auto" and link_class(platform) != "host"
+        )
+        if comp_on and mesh is not None:
+            if mode == "on":
+                logger.warning(
+                    "--secret-compress is unsupported under a sharded mesh "
+                    "(a flat wire buffer has no row axis to shard); "
+                    "shipping raw slabs"
+                )
+            comp_on = False
+        if comp_on and self.chunk_len % 8:
+            logger.warning(
+                "--secret-compress needs chunk_len %% 8 == 0 (7-bit "
+                "packing), got %d; shipping raw slabs", self.chunk_len,
+            )
+            comp_on = False
+        self.compress_min_ratio = float(
+            compress_min_ratio or tuning.compress_min_ratio
+            or COMPRESS_MIN_RATIO
+        )
+        if not 0.0 < self.compress_min_ratio <= 1.0:
+            raise ValueError(
+                f"compress_min_ratio={self.compress_min_ratio} out of (0, 1]"
+            )
+        self._codec = None
+        # per rows-bucket wire-size ladder {top, top/2, top/4, top/8}: the
+        # wire buffer buckets to a rung so decompress compiles once per
+        # (rows, rung) pair, and a very compressible batch (zero pages,
+        # config trees) rides a small rung instead of padding to the top
+        self._wire_rungs: dict[int, list[int]] = {}
+        if comp_on:
+            from trivy_tpu.ops.decompress import build_decompress_fn
+            from trivy_tpu.secret.compress import SlabCodec
+
+            self._codec = SlabCodec(self.chunk_len)
+            self._staged.add_stage(
+                "decompress",
+                build_decompress_fn(
+                    self.chunk_len, self._codec.tab_bytes,
+                    self._codec.tab_len,
+                ),
+                out_axes=2,
+            )
+            for b in self._buckets:
+                top = -(-int(b * self.chunk_len * self.compress_min_ratio)
+                        // 128) * 128
+                rungs = [top]
+                while len(rungs) < 4 and rungs[-1] // 2 >= 1024:
+                    rungs.append(rungs[-1] // 2)
+                self._wire_rungs[b] = sorted(rungs)
+        self.compress_on = comp_on
+
     # -- dedup hit cache ----------------------------------------------------
     #
     # Cached value per row digest (the "row verdict"): a 4-tuple
@@ -594,6 +689,25 @@ class TpuSecretScanner:
                 # close the warm batch's busy interval: warm-up must not
                 # pin the utilization telemetry's in-flight accounting
                 self._staged.record_result(didx, True)
+        if self._codec is None:
+            return
+        # compressed path: one decompress compile per (rows, wire-rung)
+        # pair; the downstream stages reuse the raw-shape executables
+        # compiled above (the decoder's [b, C] output IS the raw shape)
+        for b in self._buckets:
+            for rung in self._wire_rungs[b]:
+                frame = (
+                    np.zeros(rung, dtype=np.uint8),
+                    np.zeros(b, dtype=np.int32),
+                    np.zeros(b, dtype=np.int32),
+                    np.zeros(b, dtype=np.uint8),
+                )
+                for _ in range(max(1, self._staged.n_streams)):
+                    dev, didx = self._staged.put_parts(frame)
+                    rows = self._staged.run("decompress", dev, didx)
+                    for name in stages:
+                        np.asarray(self._staged.run(name, rows, didx))
+                    self._staged.record_result(didx, True)
 
     def _ensure_license_stage(self) -> None:
         """Register the license gram-gate kernel as a fused stage (once per
@@ -665,6 +779,8 @@ class TpuSecretScanner:
             "arena_slabs": self.arena_slabs,  # 0 = derived per scan
             "bucket_ladder": list(self._buckets),
             "controller": bool(self.tuning.controller),
+            "compress": self.compress_on,
+            "compress_min_ratio": self.compress_min_ratio,
             "topology": self.tuning.topology,
             "source": dict(self.tuning.source),
         }
@@ -819,6 +935,9 @@ class _ScanRun:
         self.error: BaseException | None = None
         self.degraded = False
         self.stop = threading.Event()
+        # wire-accounting baseline: scanner stats are cumulative across
+        # scans, so this run's compression ratio needs a delta
+        self._stats0 = sc.stats.snapshot()
         self.feed_done = threading.Event()  # input exhausted (or failed)
         streams = sc.feed_streams
         # online tuning (trivy_tpu/tuning.py): the controller adapts the
@@ -1005,6 +1124,32 @@ class _ScanRun:
             "arena_slabs": self.arena.n_slabs,
             "controller": ctl_summary,
         }
+        # wire-format accounting for THIS run: the `wire` block in
+        # --metrics-out, the per-rep wire_compression_ratio in bench, and
+        # the process-global gauge on GET /metrics. Compression-off scans
+        # publish nothing (no block, no gauge registration) — the
+        # zero-cost-when-off bar bench --smoke enforces
+        if self.sc._codec is not None:
+            from trivy_tpu.obs.metrics import REGISTRY
+
+            d = self.sc.stats.snapshot()
+            w = {k: d[k] - self._stats0[k] for k in (
+                "bytes_compressed", "bytes_raw_equiv", "bytes_raw_fallback",
+                "bytes_gated", "bytes_gated_binary", "chunks_gated_zero",
+                "batches_compressed", "batches_raw_fallback",
+            )}
+            raw_equiv = w["bytes_raw_equiv"] + w["bytes_raw_fallback"]
+            shipped = w["bytes_compressed"] + w["bytes_raw_fallback"]
+            ratio = shipped / raw_equiv if raw_equiv else 1.0
+            wire = {"compress": True, "compression_ratio": ratio, **w}
+            self.sc._last_wire = wire
+            if self.ctx is not None:
+                self.ctx.wire = wire
+            REGISTRY.gauge(
+                "trivy_tpu_wire_compression_ratio",
+                "Link bytes shipped per raw slab byte on the most recent "
+                "compressed-feed scan (1.0 = raw)",
+            ).set(ratio)
 
     # -- shared control -----------------------------------------------------
 
@@ -1330,7 +1475,27 @@ class _ScanRun:
         def recover(batch, meta, slab_id, retries, err) -> list:
             """Ladder decision for one failed batch: work items to
             re-dispatch, or raise when the ladder is exhausted. Always
-            ends the source slab's ownership."""
+            ends the source slab's ownership.
+
+            A compressed batch degrades to raw rows FIRST (the host
+            reference decoder, byte-identical to the device kernel by the
+            codec fuzz contract): every rung of the ladder — whole-batch
+            retry, OOM halves, host fallback — then runs on plain rows,
+            so a decoder-side failure can never loop through the codec."""
+            if isinstance(batch, CompressedSlab):
+                try:
+                    batch = sc._codec.decode_slab(batch)
+                except Exception as dec_err:
+                    # an undecodable frame is an encoder bug, not a device
+                    # fault: no retry can fix it — escalate to the exact
+                    # host path, which rereads original file bytes
+                    logger.warning(
+                        "compressed batch unrecoverable after device error "
+                        "(%s); decode failed: %s", err, dec_err,
+                    )
+                    if slab_id is not None:
+                        self.arena.release(slab_id)
+                    raise _DeviceFailed(err)
             if isinstance(err, DevicesUnavailable):
                 if slab_id is not None:
                     self.arena.release(slab_id)
@@ -1382,10 +1547,26 @@ class _ScanRun:
                 placed = False
                 didx = None
                 try:
-                    with ctx.span("secret.dispatch"):
-                        dev, didx = staged.put(b)
-                        placed = True
+                    if isinstance(b, CompressedSlab):
+                        # ship the wire frame, expand on device ahead of
+                        # every other stage; the decompressed rows are
+                        # the resident array the stages read, so
+                        # downstream dispatch is shape-identical to the
+                        # raw path. The frame placement stays in the
+                        # upload bucket (it IS the link transfer); only
+                        # the decode launch is codec time
+                        with ctx.span("secret.dispatch"):
+                            parts, didx = staged.put_parts(b.arrays())
+                            placed = True
+                        with ctx.span("secret.decompress"):
+                            dev = staged.run("decompress", parts, didx)
                         h: dict = {}
+                    else:
+                        with ctx.span("secret.dispatch"):
+                            dev, didx = staged.put(b)
+                            placed = True
+                        h = {}
+                    with ctx.span("secret.dispatch"):
                         if use_pf:
                             h["pre"] = staged.run("prefilter", dev, didx)
                         else:
@@ -1586,6 +1767,9 @@ class _ScanRun:
                 lic_gate.skip(path)
 
         persist_on = dedup and sc._hit_store.backend is not None
+        # compressed feed on -> the zero gate is on (all-zero rows resolve
+        # through a forced dedup key instead of crossing the link again)
+        zero_gate = sc._codec is not None
         slab_id: int | None = None
         slab: np.ndarray | None = None
         used = 0
@@ -1705,6 +1889,57 @@ class _ScanRun:
                 slab[: len(live)] = slab[np.asarray(live)]
             meta = [meta[i] for i in live]
 
+        def compress_slab(n: int):
+            """Try to compress the assembled slab's live rows into a wire
+            frame riding a SECOND arena slab (the wire stays in pinned,
+            reused memory and inherits arena backpressure). Returns the
+            dispatch-queue item ``(dst_slab_id, CompressedSlab, meta)``,
+            or None for the raw fallback: the batch can't beat the
+            min-ratio wire budget, or the encoder errored (degrade to raw
+            is the codec's failure contract, never a failed scan)."""
+            dst_id = None
+            try:
+                with ctx.span("secret.compress"):
+                    plan = sc._codec.plan(slab[: len(meta)])
+                    total = plan.total()
+                    rung = next(
+                        (r for r in sc._wire_rungs[n] if r >= total), None
+                    )
+                    if rung is None:
+                        return None  # doesn't pay — ship the raw slab
+                    got = self.arena.acquire(self._aborted)
+                    if got is None:
+                        raise _FeedAbort
+                    dst_id, dst = got
+                    cs = sc._codec.emit(plan, n, rung, dst.reshape(-1))
+            except _FeedAbort:
+                raise
+            except Exception as e:
+                logger.warning(
+                    "slab compression failed (%s: %s); shipping raw",
+                    type(e).__name__, e,
+                )
+                if dst_id is not None:
+                    self.arena.release(dst_id)
+                return None
+            wire = rung + cs.frame_bytes()
+            bin_rows = int(plan.binary.sum())
+            stats.add(
+                bytes_uploaded=wire,
+                bytes_compressed=wire,
+                bytes_raw_equiv=n * chunk_len,
+                bytes_gated_binary=bin_rows * chunk_len,
+                batches_compressed=1,
+            )
+            if enabled:
+                ctx.count("secret.bytes_uploaded", wire)
+                ctx.count("secret.bytes_compressed", wire)
+                if bin_rows:
+                    ctx.count(
+                        "secret.bytes_gated_binary", bin_rows * chunk_len
+                    )
+            return (dst_id, cs, meta)
+
         def flush() -> None:
             nonlocal slab_id, slab, used, meta
             flush_copies()
@@ -1726,13 +1961,33 @@ class _ScanRun:
                     emit_pack()
                 return
             n = next(b for b in sc._buckets if b >= len(meta))
-            stats.add(bytes_uploaded=n * chunk_len)
-            if enabled:
-                ctx.count("secret.bytes_uploaded", n * chunk_len)
-                ctx.sample("secret.queue_depth", self.in_q.qsize())
-            ok = self._put_slab((slab_id, slab[:n], meta))
-            if not ok:
+            item = None
+            if sc._codec is not None:
+                item = compress_slab(n)
+            if item is None:
+                # raw slab (codec off, fallback, or incompressible batch)
+                stats.add(bytes_uploaded=n * chunk_len)
+                if sc._codec is not None:
+                    stats.add(
+                        bytes_raw_fallback=n * chunk_len,
+                        batches_raw_fallback=1,
+                    )
+                    if enabled:
+                        ctx.count(
+                            "secret.bytes_raw_fallback", n * chunk_len
+                        )
+                if enabled:
+                    ctx.count("secret.bytes_uploaded", n * chunk_len)
+                item = (slab_id, slab[:n], meta)
+            else:
+                # the wire frame rides its own slab; the source slab is
+                # done the moment the encoder copied out of it
                 self.arena.release(slab_id)
+            if enabled:
+                ctx.sample("secret.queue_depth", self.in_q.qsize())
+            ok = self._put_slab(item)
+            if not ok:
+                self.arena.release(item[0])
             slab_id = None
             slab = None
             used = 0
@@ -1756,7 +2011,15 @@ class _ScanRun:
             pack_pending.clear()
             pack_len = 0
             key = None
-            if dedup:
+            # the zero gate extends to single-file pack rows (a tree of
+            # zero-filled placeholder files): same forced-key trick as
+            # feed_big's chunk rows, same digest domain
+            single_zero = (
+                zero_gate
+                and len(items) == 1
+                and not any(items[0][1])
+            )
+            if dedup or single_zero:
                 if len(items) == 1:
                     # single-segment row == plain chunk-row layout: share the
                     # plain digest domain so it dedups across both paths
@@ -1775,6 +2038,10 @@ class _ScanRun:
             nbytes = sum(len(d) for _, d in items)
             stats.add(chunks=1)
             if route_row(key, segs, nbytes):
+                if single_zero:
+                    stats.add(bytes_gated=nbytes, chunks_gated_zero=1)
+                    if enabled:
+                        ctx.count("secret.bytes_gated", nbytes)
                 return
             ensure_slab()
             row = slab[used]
@@ -1826,13 +2093,28 @@ class _ScanRun:
             uploaded = 0
             for s in starts:
                 end = min(s + chunk_len, n)
+                # zero gate (compressed feed's "never ship unscannable
+                # bytes"): all-zero rows — sparse images, zero pages —
+                # get a forced dedup key even with dedup off, so the
+                # first one ships (possibly compressed 8x) and every
+                # other resolves through the ordinary dedup/coalesce
+                # machinery. Soundness-free by construction: the row
+                # still rides the real verdict path once, so a ruleset
+                # that somehow matches NUL runs keeps its findings
+                is_zero = zero_gate and not arr[s:end].any()
                 key = (
                     blake2b(arr[s:end], digest_size=16, key=fp_key).digest()
-                    if dedup
+                    if dedup or is_zero
                     else None
                 )
                 segs = [(fidx, s, s + chunk_len)]
                 if route_row(key, segs, end - s):
+                    if is_zero:
+                        stats.add(
+                            bytes_gated=end - s, chunks_gated_zero=1
+                        )
+                        if enabled:
+                            ctx.count("secret.bytes_gated", end - s)
                     continue
                 ensure_slab()
                 if end - s == chunk_len:
