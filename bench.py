@@ -641,14 +641,27 @@ def bench_license(rng) -> dict:
             )
     total = sum(len(t) for t in texts)
 
+    from trivy_tpu import obs
+
     def timed(clf):
         clf.classify_batch(texts)  # warm-up (scoring tables + compiles)
-        t0 = time.perf_counter()
-        results = clf.classify_batch(texts)
-        return total / (time.perf_counter() - t0) / (1024 * 1024), results
+        with obs.scan_context(name="bench-license", enabled=True) as ctx:
+            t0 = time.perf_counter()
+            results = clf.classify_batch(texts)
+            dt = time.perf_counter() - t0
+            uploaded = ctx.counters.get("license.bytes_uploaded", 0)
+        return total / dt / (1024 * 1024), results, uploaded
 
-    host_mbs, host_results = timed(LicenseClassifier(backend="cpu"))
-    device_mbs, results = timed(LicenseClassifier(backend="device"))
+    host_mbs, host_results, _ = timed(LicenseClassifier(backend="cpu"))
+    device_mbs, results, uploaded = timed(LicenseClassifier(backend="device"))
+    # the guarded headline is the PRODUCTION path (backend="auto"): on an
+    # accelerator that is the raw-bytes device leg; on this CPU-backend
+    # container "device" is the same single throttled core plus dispatch
+    # overhead, so auto resolves to host and the forced-device leg rides
+    # detail only (BASELINE.md "CPU-backend caveat") — both legs are
+    # always measured and recorded
+    auto_device = LicenseClassifier()._use_device(len(texts))
+    auto_mbs = device_mbs if auto_device else host_mbs
     n_found = sum(1 for r in results if r)
     correct = sum(
         1
@@ -665,10 +678,11 @@ def bench_license(rng) -> dict:
     )
     return {
         "metric": "license_classify_throughput",
-        "value": round(device_mbs, 2),
+        "value": round(auto_mbs, 2),
         "unit": "MB/s",
         "vs_cpu_baseline": round(device_mbs / max(host_mbs, 1e-9), 3),
         "detail": {
+            "auto_backend": "device" if auto_device else "cpu",
             "device_mbs": round(device_mbs, 2),
             "cpu_engine_mbs": round(host_mbs, 2),
             "texts": len(texts),
@@ -676,6 +690,13 @@ def bench_license(rng) -> dict:
             "top1_correct": correct,
             "top1_parity": f"{parity}/{n_license}",
             "license_files": n_license,
+            # link traffic of the raw-bytes device path: uint8 arena rows
+            # only (no host gram extraction, no int32 gram-row upload) —
+            # lower-is-better, guarded by --check-regression
+            "license_link_bytes_per_text_byte": round(
+                uploaded / max(total, 1), 4
+            ),
+            "license_bytes_uploaded": int(uploaded),
         },
     }
 
@@ -811,11 +832,14 @@ def bench_fused(scanner, rng) -> dict:
 
 
 def bench_cve(rng) -> dict:
-    """BASELINE config 4 analog: 50k-package CVE match against a
-    realistically-shaped advisory DB — >=100k advisories spread over the
+    """BASELINE config 4 analog: 100k-package multi-ecosystem SBOM against
+    a realistically-shaped advisory DB — >=100k advisories spread over the
     real trivy-db bucket-name schema (multiple '<eco>::<source>' buckets
-    per ecosystem, messy pre-release versions), exercising the merged
-    prefix index and the batched device constraint path."""
+    per ecosystem, messy pre-release versions). The whole SBOM rides ONE
+    resident-join dispatch (detect_batch) against the HBM-resident global
+    bound matrix; the timed run is the SECOND scan, so it also proves the
+    matrix survives across scans (zero bound-table upload bytes)."""
+    from trivy_tpu import obs
     from trivy_tpu.db import Advisory, VulnDB
     from trivy_tpu.detector import library
     from trivy_tpu.types import Application, Package
@@ -853,22 +877,54 @@ def bench_cve(rng) -> dict:
             n_adv += 1
         buckets[bname] = pkgs_b
     db = VulnDB(buckets=buckets, details={})
-    pkgs = [
-        Package(
-            name=f"npm-pkg-{i % 15_000:05d}",
-            version=f"{rng.integers(1, 10)}.{rng.integers(0, 10)}."
-            f"{rng.integers(0, 10)}",
-        )
-        for i in range(n_pkgs)
+
+    def mkpkgs(eco, n, names):
+        return [
+            Package(
+                name=f"{eco}-pkg-{i % names:05d}",
+                version=f"{rng.integers(1, 10)}.{rng.integers(0, 10)}."
+                f"{rng.integers(0, 10)}",
+            )
+            for i in range(n)
+        ]
+
+    pkgs = mkpkgs("npm", n_pkgs, 15_000)
+    # encodable-scheme ecosystems only (semver): pep440 apps would fall
+    # back to the per-candidate host comparator and measure that instead
+    apps = [
+        Application(
+            type="npm", file_path="package-lock.json", packages=pkgs
+        ),
+        Application(
+            type="gomod", file_path="go.mod",
+            packages=mkpkgs("go", 30_000, 7_500),
+        ),
+        Application(
+            type="cargo", file_path="Cargo.lock",
+            packages=mkpkgs("cargo", 20_000, 2_000),
+        ),
     ]
-    app = Application(type="npm", file_path="package-lock.json", packages=pkgs)
-    library.detect(db, app)  # warm-up / compile
-    t0 = time.perf_counter()
-    vulns = library.detect(db, app)
-    dt = time.perf_counter() - t0
-    # CPU-engine baseline: the per-candidate host comparator over a subset
-    # (forcing BATCH_THRESHOLD above the batch keeps detect() on the
-    # pure-host _is_vulnerable path), scaled to a rate
+    sbom_pkgs = sum(len(a.packages) for a in apps)
+    library.detect_batch(db, apps)  # warm-up: compiles + join upload
+    rj = db._lib_resident
+    d0 = rj.dispatch_count
+    dt = float("inf")
+    resident_upload = 0
+    for _ in range(3):  # best-of-3: single-shot is noise on shared CPUs
+        with obs.scan_context(name="cve-resident", enabled=True) as ctx:
+            t0 = time.perf_counter()
+            out = library.detect_batch(db, apps)
+            dt = min(dt, time.perf_counter() - t0)
+            resident_upload += ctx.counters.get(
+                "cve.bounds_bytes_uploaded", 0
+            )
+    vulns = [v for vs in out for v in vs]
+    dispatches = (rj.dispatch_count - d0) // 3
+    # CPU-engine baseline: the per-candidate host comparator over an npm
+    # subset (forcing BATCH_THRESHOLD above the batch keeps detect() on
+    # the pure-host _is_vulnerable path), scaled to a rate — the same
+    # baseline leg every prior round measured, so the guarded ratio stays
+    # definitionally comparable across rounds
     cpu_n = 5_000
     cpu_app = Application(
         type="npm", file_path="package-lock.json", packages=pkgs[:cpu_n]
@@ -876,20 +932,32 @@ def bench_cve(rng) -> dict:
     saved = library.BATCH_THRESHOLD
     library.BATCH_THRESHOLD = 1 << 30
     try:
-        t0 = time.perf_counter()
-        library.detect(db, cpu_app)
-        cpu_dt = time.perf_counter() - t0
+        cpu_dt = float("inf")
+        for _ in range(3):
+            # the reference CPU engine re-parses per check: drop the batch
+            # path's memo so the baseline stays the same cold-parse leg
+            # every prior round measured
+            library._bound_version.cache_clear()
+            t0 = time.perf_counter()
+            library.detect(db, cpu_app)
+            cpu_dt = min(cpu_dt, time.perf_counter() - t0)
     finally:
         library.BATCH_THRESHOLD = saved
     cpu_rate = cpu_n / max(cpu_dt, 1e-9)
-    rate = n_pkgs / dt
+    rate = sbom_pkgs / dt
     return {
         "metric": "cve_match_rate",
         "value": round(rate, 0),
         "unit": "pkgs/s",
         "vs_cpu_baseline": round(rate / cpu_rate, 3),
-        "detail": {"packages": n_pkgs, "advisories": n_adv,
+        "detail": {"packages": sbom_pkgs, "applications": len(apps),
+                   "advisories": n_adv,
                    "buckets": len(buckets), "matches": len(vulns),
+                   # resident-join leg: the whole SBOM in one dispatch,
+                   # and the second scan re-uploads no bound bytes
+                   "dispatches_per_scan": int(dispatches),
+                   "resident_second_scan_upload_bytes": int(resident_upload),
+                   "resident_bound_bytes": int(rj.upload_bytes),
                    "cpu_engine_rate": round(cpu_rate, 0),
                    "cpu_engine_pkgs": cpu_n},
     }
@@ -1068,16 +1136,80 @@ def bench_chaos(rng) -> dict:
     }
 
 
+def _chaos_license(rng) -> dict:
+    """License chaos leg: a ``device.dispatch@license`` fault landing
+    MID-batch (the first license dispatch succeeds, a later one faults)
+    must degrade ONLY the license stage — findings identical to the host
+    oracle — while the secret stage's device feed keeps running under the
+    armed fault and still surfaces its planted secrets. RuntimeErrors
+    here fail the ``--chaos`` gate like the secret leg's."""
+    from trivy_tpu import faults, obs
+    from trivy_tpu.licensing.classify import LicenseClassifier
+    from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
+    from trivy_tpu.licensing.fused import FusedLicenseGate
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    # two row-width groups -> >=2 license dispatches, so at=2 faults
+    # strictly mid-batch
+    texts = [FULL_TEXTS[k] for k in sorted(FULL_TEXTS)[:8]]
+    texts += [FULL_TEXTS["MIT"] + " more filler words here " * 300] * 4
+    host = LicenseClassifier(backend="cpu").classify_batch(texts)
+    scanner = TpuSecretScanner(chunk_len=2048, batch_size=8)
+    files = [(f"t{i}/LICENSE", t.encode()) for i, t in enumerate(texts)]
+    files += make_corpus(1, rng)  # planted secrets ride the same scan
+    faults.configure("device.dispatch@license:at=2:times=-1")
+    try:
+        with obs.scan_context(name="chaos-license", enabled=True) as ctx:
+            secret_findings = sum(
+                len(s.findings)
+                for s in scanner.scan_files(
+                    iter(files), license_gate=FusedLicenseGate(
+                        license_full=True
+                    )
+                )
+            )
+            dev = LicenseClassifier(backend="device").classify_batch(texts)
+            degraded = ctx.counters.get("license.degraded", 0)
+    finally:
+        faults.clear()
+    if degraded < 1:
+        raise RuntimeError(
+            "license chaos leg never degraded (the injected "
+            "device.dispatch@license fault missed live traffic)"
+        )
+    if not secret_findings:
+        raise RuntimeError(
+            "secret stage surfaced zero findings under the license fault "
+            "(the fault must stay contained to the license stage)"
+        )
+    for i, (a, b) in enumerate(zip(host, dev)):
+        if [(f.name, f.confidence) for f in a] != [
+            (f.name, f.confidence) for f in b
+        ]:
+            raise RuntimeError(
+                f"license chaos leg lost parity with the host oracle on "
+                f"text {i}"
+            )
+    return {
+        "degraded_dispatches": degraded,
+        "secret_findings": secret_findings,
+        "parity": "ok",
+    }
+
+
 def chaos() -> int:
     """``bench.py --chaos``: the recovery gate, wired like ``--smoke`` —
     exits 1 unless the injected mid-rep device fault recovers with parity
     AND the fleet fault sites (``fleet.dispatch``/``fleet.steal``/
     ``fleet.result`` + admission shed pressure) prove shed-not-crash and
-    lose-one-replica-not-the-scan."""
+    lose-one-replica-not-the-scan AND a license-stage device fault
+    degrades only the license leg (host-oracle parity, secrets keep
+    flowing)."""
     rng = np.random.default_rng(13)
     try:
         out = bench_chaos(rng)
         out["fleet"] = _chaos_fleet(rng)
+        out["license"] = _chaos_license(rng)
     except RuntimeError as e:
         print(f"FATAL: {e}", file=sys.stderr)
         return 1
@@ -2060,6 +2192,130 @@ def _smoke_compress() -> str | None:
     return None
 
 
+def _smoke_license_device() -> str | None:
+    """Raw-bytes license scoring gates. (1) Zero-cost-when-off: a
+    cpu-backend classifier must never build the device scorer, upload
+    corpus bytes, or record device spans/counters — the host path is
+    byte-identical to pre-device rounds. (2) Device-on earns its keep:
+    a corpus-text batch records nonzero ``license.score_rows`` (the
+    scoring kernel actually ran, the gate didn't silently drop every
+    row) and its only link traffic is the raw text rows themselves.
+    Returns an error string on violation."""
+    from trivy_tpu import obs
+    from trivy_tpu.licensing.classify import LicenseClassifier
+    from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
+    from trivy_tpu.ops import ngram_score as ng
+
+    texts = [FULL_TEXTS[k] for k in sorted(FULL_TEXTS)[:8]]
+    # the off leg runs FIRST: the bytes scorer is process-global once any
+    # device classify builds it, so absence is only checkable while no
+    # device leg has fired in this process
+    cache_was_empty = not any(
+        k[0] == "bytes" for k in ng._SCORER_CACHE
+    )
+    off = LicenseClassifier(backend="cpu")
+    with obs.scan_context(name="smoke-license-off", enabled=True) as ctx:
+        off_out = off.classify_batch(texts)
+    if off._scorer is not None:
+        return "cpu-backend classifier built a DeviceBytesScorer"
+    if cache_was_empty and any(k[0] == "bytes" for k in ng._SCORER_CACHE):
+        return "cpu-backend classify populated the device scorer cache"
+    booked = [
+        n for n in ("license.bytes_uploaded", "license.score_rows")
+        if ctx.counters.get(n)
+    ]
+    if booked:
+        return f"cpu-backend classify booked device counter(s): {booked}"
+    spans = [
+        n for n, durs in ctx.snapshot().items()
+        if durs and n in ("license.dispatch", "license.device_wait")
+    ]
+    if spans:
+        return f"cpu-backend classify recorded device span(s): {spans}"
+
+    on = LicenseClassifier(backend="device")
+    with obs.scan_context(name="smoke-license-on", enabled=True) as ctx:
+        on_out = on.classify_batch(texts)
+    if not ctx.counters.get("license.score_rows"):
+        return (
+            "device-backend classify recorded zero license.score_rows "
+            "(the scoring kernel never ran — gate dropped every corpus "
+            "text, or the device leg silently fell back to host)"
+        )
+    if not ctx.counters.get("license.bytes_uploaded"):
+        return "device-backend classify uploaded zero text-row bytes"
+    names = lambda batches: [
+        [f.name for f in fs] for fs in batches
+    ]
+    if names(off_out) != names(on_out):
+        return "device-backend findings diverged from the host oracle"
+    return None
+
+
+def _smoke_cve_resident() -> str | None:
+    """HBM-resident CVE join gate: the global bound matrix uploads ONCE —
+    a second scan of the same db moves zero bound-table bytes over the
+    link and still rides exactly one device dispatch. Returns an error
+    string on violation."""
+    from trivy_tpu import obs
+    from trivy_tpu.db import Advisory, VulnDB
+    from trivy_tpu.detector import library
+    from trivy_tpu.types import Application, Package
+
+    rng = np.random.default_rng(23)
+    advs = {
+        f"pkg-{i:03d}": [
+            Advisory(
+                vulnerability_id=f"CVE-2024-{i:04d}",
+                vulnerable_versions=[f">={i % 5}.0.0, <{i % 5 + 1}.2.0"],
+                patched_versions=[f"{i % 5 + 1}.2.0"],
+            )
+        ]
+        for i in range(64)
+    }
+    db = VulnDB(buckets={"npm::smoke": advs}, details={})
+    pkgs = [
+        Package(
+            name=f"pkg-{rng.integers(0, 96):03d}",
+            version=f"{rng.integers(0, 7)}.{rng.integers(0, 4)}.0",
+        )
+        for _ in range(600)  # above BATCH_THRESHOLD -> resident join path
+    ]
+    apps = [Application(type="npm", file_path="lock", packages=pkgs)]
+    with obs.scan_context(name="smoke-cve-1", enabled=True) as ctx:
+        out1 = library.detect_batch(db, apps)
+        first = ctx.counters.get("cve.bounds_bytes_uploaded", 0)
+    if not first:
+        return (
+            "first resident-join scan uploaded zero bound-table bytes "
+            "(the join never reached the device)"
+        )
+    rj = db._lib_resident
+    d0 = rj.dispatch_count
+    with obs.scan_context(name="smoke-cve-2", enabled=True) as ctx:
+        out2 = library.detect_batch(db, apps)
+        second = ctx.counters.get("cve.bounds_bytes_uploaded", 0)
+        degraded = ctx.counters.get("cve.degraded", 0)
+    if second:
+        return (
+            f"second scan re-uploaded {second} bound-table bytes (the "
+            f"matrix must stay device-resident across scans)"
+        )
+    if degraded:
+        return "second resident-join scan degraded to the host comparator"
+    if rj.dispatch_count - d0 != 1:
+        return (
+            f"second scan took {rj.dispatch_count - d0} device dispatches "
+            f"(the whole SBOM must ride exactly one)"
+        )
+    key = lambda vs: [
+        (v.pkg_name, v.vulnerability_id, v.fixed_version) for v in vs
+    ]
+    if key(out1[0]) != key(out2[0]) or not out1[0]:
+        return "second resident-join scan diverged from the first"
+    return None
+
+
 def _smoke_client_mode() -> tuple[list[str], dict, str]:
     """Client-mode traced rep against an in-process server: returns the
     server-side stage names that joined the client trace, the merged
@@ -2253,6 +2509,14 @@ def smoke(trace_out=None, metrics_out=None) -> int:
     if cmp_err:
         print(f"FATAL: {cmp_err}", file=sys.stderr)
         return 1
+    lic_err = _smoke_license_device()
+    if lic_err:
+        print(f"FATAL: {lic_err}", file=sys.stderr)
+        return 1
+    cve_err = _smoke_cve_resident()
+    if cve_err:
+        print(f"FATAL: {cve_err}", file=sys.stderr)
+        return 1
     server_stages, client_profile, client_trace_id = _smoke_client_mode()
     if not server_stages:
         print(
@@ -2281,6 +2545,9 @@ def smoke(trace_out=None, metrics_out=None) -> int:
                 "tuning_controller": "ok",  # schema + zero-cost gates held
                 "admission_off": "ok",  # zero-cost-when-off gate held
                 "compress": "ok",  # off = zero-cost, on = beats raw
+                "license_device": "ok",  # off = zero-cost, on = scores
+                "cve_resident": "ok",  # second scan = zero upload, 1 disp
+
                 "fleet_off": "ok",  # no fabric state without --fleet
                 "incremental_off": "ok",  # no incremental state without flags
                 "incremental": "ok",  # warm re-scan = pure stat-walk, parity
@@ -2402,6 +2669,7 @@ REGRESSION_THRESHOLD = 0.15
 # byte): a >threshold RISE fails exactly like a throughput drop
 LOWER_IS_BETTER = {
     "device_bytes_uploaded_per_scanned_byte",
+    "license_link_bytes_per_text_byte",
     "saturation_p95_ms",
     "wire_compression_ratio",
 }
@@ -2481,6 +2749,13 @@ def _metric_values(doc: dict) -> dict:
             eff = (m.get("detail") or {}).get("scaling_efficiency_4x")
             if isinstance(eff, (int, float)):
                 out["scaling_efficiency_4x"] = float(eff)
+        if m.get("metric") == "license_classify_throughput":
+            # raw-bytes device scoring exists to keep the license leg off
+            # the host link: guard its per-text-byte upload cost the same
+            # way the secret pipeline's link cost is guarded
+            lb = (m.get("detail") or {}).get("license_link_bytes_per_text_byte")
+            if isinstance(lb, (int, float)):
+                out["license_link_bytes_per_text_byte"] = float(lb)
         if m.get("metric") == "cve_match_rate":
             # the device-vs-host CVE matching gap is a headline-adjacent
             # metric (ROADMAP item 3 landed on device in PR 1): a

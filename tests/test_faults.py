@@ -399,6 +399,48 @@ def test_license_device_leg_falls_back_to_host():
         )
 
 
+def test_license_fault_mid_batch_degrades_license_only():
+    """Chaos leg: ``device.dispatch@license`` faulting MID-batch (the
+    first dispatch lands, a later one faults) degrades ONLY the license
+    stage to the host oracle — findings parity holds — while the secret
+    stage's device feed (keyed ``d<i>``) keeps running under the armed
+    fault and still reports its findings."""
+    from tests.secret_samples import SAMPLES
+    from trivy_tpu.licensing.classify import LicenseClassifier
+    from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
+    from trivy_tpu.licensing.fused import FusedLicenseGate
+    from trivy_tpu.secret.tpu_scanner import TpuSecretScanner
+
+    # two row-width groups -> at least two license dispatches, so at=2
+    # faults strictly mid-batch
+    texts = [FULL_TEXTS[k] for k in sorted(FULL_TEXTS)[:8]]
+    texts += [FULL_TEXTS["MIT"] + " more filler words here " * 300] * 4
+    host = LicenseClassifier(backend="cpu").classify_batch(texts)
+
+    scanner = TpuSecretScanner(
+        ScannerConfig.from_dict({"enable-builtin-rules": ["github-pat"]}),
+        chunk_len=2048, batch_size=8,
+    )
+    gate = FusedLicenseGate(license_full=True)
+    files = [(f"t{i}/LICENSE", t.encode()) for i, t in enumerate(texts)]
+    files.append(
+        ("src/cfg.py", f"token = '{SAMPLES['github-pat']}'\n".encode())
+    )
+
+    faults.configure("device.dispatch@license:at=2:times=-1")
+    with obs.scan_context(name="chaos-lic", enabled=True) as ctx:
+        secret_findings = list(
+            scanner.scan_files(iter(files), license_gate=gate)
+        )
+        dev = LicenseClassifier(backend="device").classify_batch(texts)
+        assert ctx.counters.get("license.degraded", 0) >= 1
+    assert secret_findings  # the secret stage kept running
+    for a, b in zip(host, dev):
+        assert [(f.name, f.confidence) for f in a] == [
+            (f.name, f.confidence) for f in b
+        ]
+
+
 # -- cache failure domain ----------------------------------------------------
 
 

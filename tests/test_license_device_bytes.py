@@ -1,0 +1,154 @@
+"""Raw-bytes device license scoring: tokenizer parity on adversarial
+inputs (satellite of the device-scoring tentpole).
+
+The device path tokenizes raw uint8 rows on device (latin-1 bytes through
+the same byte LUT the host uses), so host and device must agree
+finding-for-finding on exactly the inputs where byte-level tokenizers
+drift: non-ASCII, mixed CRLF line endings, tokens longer than the shingle
+window, texts that land exactly on packed-row ladder boundaries, and
+empty/whitespace-only rows.
+"""
+
+from __future__ import annotations
+
+from trivy_tpu.licensing.classify import LicenseClassifier
+from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
+
+
+def _mit() -> str:
+    return FULL_TEXTS["MIT"]
+
+
+def _parity(texts: list[str]) -> list:
+    """Host vs device classify_batch: findings must be byte-identical
+    (full serialized finding, not just the name)."""
+    host = LicenseClassifier(backend="cpu").classify_batch(texts)
+    dev = LicenseClassifier(backend="device").classify_batch(texts)
+    for i, (a, b) in enumerate(zip(host, dev)):
+        assert [f.to_dict() for f in a] == [
+            f.to_dict() for f in b
+        ], f"text {i}: {texts[i][:60]!r}"
+    return host
+
+
+def test_non_ascii_texts_match_host():
+    mit = _mit()
+    texts = [
+        # unicode punctuation + accents sprinkled through a real license
+        mit.replace("copyright", "cópyright “notice”"),
+        # CJK run embedded mid-license
+        mit[: len(mit) // 2] + " 许可证 MIT 许可 " + mit[len(mit) // 2 :],
+        # emoji + unencodable astral chars (latin-1 'replace' on device)
+        "\U0001f512 " + mit + " \U0001f513",
+        # fully non-latin text: no license, must stay empty on both engines
+        "договір " * 200,
+        mit + " café straße ñandú",
+        # NBSP / zero-width joiners between words
+        mit.replace(" ", " ", 5),
+        mit,
+        "﻿" + mit,  # BOM prefix
+    ]
+    _parity(texts)
+
+
+def test_mixed_crlf_line_endings_match_host():
+    mit = _mit()
+    lines = mit.split(" ")
+    texts = [
+        mit.replace(". ", ".\r\n"),
+        mit.replace(". ", ".\r"),
+        # alternating \r\n / \n / \r between words
+        "".join(
+            w + ("\r\n", "\n", "\r", " ")[i % 4] for i, w in enumerate(lines)
+        ),
+        "\r\n" * 50 + mit + "\r" * 50,
+        mit.replace(" ", "\t\r\n", 20),
+        mit.replace("\n", "\r\n") if "\n" in mit else mit + "\r\n",
+        mit,
+        mit.replace(". ", " .\r\n. "),
+    ]
+    _parity(texts)
+
+
+def test_over_window_tokens_match_host():
+    mit = _mit()
+    giant = "x" * 300  # longer than the 8-byte shingle window
+    texts = [
+        giant + " " + mit,
+        mit + " " + giant,
+        mit.replace(". ", f". {giant} ", 3),
+        giant,  # one token, no license
+        ("y" * 9 + " ") * 400,  # every token just over the window
+        ("z" * 65600),  # single token wider than the widest row
+        mit + " " + "w" * 70000,  # license + token forcing the wide path
+        mit,
+    ]
+    _parity(texts)
+
+
+def test_packed_row_ladder_boundaries_match_host():
+    """Texts landing exactly on/around the packed-row width ladder
+    (1024/2048/... byte rows): the segment boundary must not split or
+    duplicate grams."""
+    from trivy_tpu.ops import ngram_score as ng
+
+    mit = _mit()
+
+    def sized(n: int) -> str:
+        body = mit + " "
+        while len(body) < n:
+            body += "filler words to reach the boundary "
+        return body[:n]
+
+    texts = []
+    for w in ng.BYTES_WIDTHS[:3]:
+        texts += [sized(w - 1), sized(w), sized(w + 1)]
+    texts.append(sized(ng.BYTES_WIDTHS[-1] - 1))  # widest rung
+    texts.append(sized(ng.BYTES_WIDTHS[-1]))  # first wide-path text
+    host = _parity(texts)
+    # the boundary texts still classify (the fill keeps the MIT body)
+    assert any(f.name == "MIT" for f in host[0])
+
+
+def test_empty_and_whitespace_only_match_host():
+    mit = _mit()
+    texts = [
+        "",
+        " ",
+        "\n\n\n",
+        "\t \r\n \t",
+        "  ",
+        " " * 5000,
+        mit,  # one real text so the batch exercises scoring too
+        "",
+    ]
+    host = _parity(texts)
+    for i in (0, 1, 2, 3, 5, 7):
+        assert host[i] == []
+
+
+def test_top1_parity_64_of_64():
+    """64 perturbed corpus texts: device top-1 == host top-1 on all 64."""
+    keys = sorted(FULL_TEXTS)
+    texts = []
+    i = 0
+    while len(texts) < 64:
+        base = FULL_TEXTS[keys[i % len(keys)]]
+        v = i // len(keys)
+        if v == 0:
+            texts.append(base)
+        elif v == 1:
+            texts.append(base.replace(". ", ".\r\n"))
+        elif v == 2:
+            texts.append("“" + base + "” é")
+        else:
+            texts.append("prefix " * v + base + " suffix" * v)
+        i += 1
+    host = LicenseClassifier(backend="cpu").classify_batch(texts)
+    dev = LicenseClassifier(backend="device").classify_batch(texts)
+    matches = sum(
+        1
+        for a, b in zip(host, dev)
+        if (a[0].name if a else None) == (b[0].name if b else None)
+    )
+    assert matches == 64

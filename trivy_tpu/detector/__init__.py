@@ -29,8 +29,10 @@ def detect_all(db, target: str, detail: ArtifactDetail, options) -> list[Result]
             )
         )
     if "library" in options.pkg_types:
-        for app in sorted(detail.applications, key=lambda a: (a.file_path, a.type)):
-            vulns = library.detect(db, app)
+        apps = sorted(detail.applications, key=lambda a: (a.file_path, a.type))
+        # whole-SBOM one-pass join: every app's packages hash-join and
+        # dispatch together against the HBM-resident global bound matrix
+        for app, vulns in zip(apps, library.detect_batch(db, apps)):
             fill_infos(db, vulns)
             if not vulns and not options_list_all(options):
                 continue

@@ -69,6 +69,12 @@ class _LicenseBatchAnalyzer(BatchAnalyzer):
         self._backend = "cpu" if backend == "cpu" else "auto"
         extra = getattr(options, "extra", {}) or {}
         self._host_fallback = bool(extra.get("host_fallback", True))
+        # raw-bytes device-path knobs (TuningConfig; 0 = classifier default)
+        tuning = extra.get("tuning")
+        self._gate_block_min = int(
+            getattr(tuning, "license_gate_block_min", 0) or 0
+        )
+        self._row_width = int(getattr(tuning, "license_row_width", 0) or 0)
         # shared-arena fused pass (commands.py wires it for
         # --scanners secret,license): the secret feed's device pass gates
         # license candidacy against the SAME uploaded rows, so finalize
@@ -96,7 +102,9 @@ class _LicenseBatchAnalyzer(BatchAnalyzer):
         if not targets:
             return AnalysisResult()
         clf = LicenseClassifier(
-            backend=self._backend, host_fallback=self._host_fallback
+            backend=self._backend, host_fallback=self._host_fallback,
+            gate_block_min=self._gate_block_min,
+            row_width=self._row_width,
         )
         per_file = clf.classify_batch([t for _p, t in targets])
         licenses = [

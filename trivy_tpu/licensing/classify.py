@@ -12,11 +12,15 @@ Two engines share one scoring model:
   candidate gating by a vectorized inverted gram index + per-candidate
   numpy scoring.
 - **device path** (default on accelerators; ``backend="device"`` forces
-  it anywhere): texts are tokenized and hashed host-side into sorted
-  int32 gram rows — only those rows cross the host→device link, never
-  file bytes — and scored against the SPDX corpus-fingerprint table on
-  device (``ops/ngram_score.py``), sharded over the mesh 'model' axis
-  with the table HBM-resident across scans (PAPER.md §7). Dispatches
+  it anywhere): raw uint8 text rows are the only per-scan link traffic —
+  tokenization, word hashing, 5-gram folding (the exact low-32 image of
+  the host's int64 hashes), dedup, corpus binary search and credit
+  accumulation all run on device (``ops/ngram_score.score_from_bytes``),
+  sharded over the mesh 'model' axis with the corpus table HBM-resident
+  across scans (PAPER.md §7). A two-lane shingle gate on the same
+  resident rows keeps the scoring kernel off the ~99% of files with no
+  license evidence; the host scorer remains the confirm rung for wide
+  texts, gram-cap overflows and threshold-grazing scores. Dispatches
   ride the same bucket-ladder/async-pipeline discipline as
   ``TpuSecretScanner``, so license and secret batches interleave on one
   device queue instead of serializing.
@@ -49,6 +53,11 @@ MAX_DEVICE_ROWS = 1024
 DEVICE_PIPELINE_DEPTH = 3
 # below this many texts the fixed dispatch overhead beats the device win
 DEVICE_MIN_TEXTS = 8
+# default shingle-gate density floor: 8-byte-window corpus hits required
+# in some 512-byte block of a row before the scoring kernel sees it
+# (recall-tuned: a single ~30-byte fingerprint phrase contributes ~20
+# intra-phrase windows to its block; whole-license pages saturate)
+GATE_BLOCK_MIN = 16
 
 # static scoring tables (corpus-derived, confidence-independent), built
 # once per process and shared across classifier instances — the analyzer
@@ -76,13 +85,21 @@ class LicenseClassifier:
         confidence: float = MIN_CONFIDENCE,
         mesh=None,
         host_fallback: bool = True,
+        gate_block_min: int = GATE_BLOCK_MIN,
+        row_width: int = 0,
     ):
         self.confidence = confidence
         self.backend = backend
         self.mesh = mesh  # optional ('data','model') mesh for sharded scoring
         self.host_fallback = host_fallback
+        # recall-tuned shingle-gate floor: min 8-byte-window hits in any
+        # 512-byte block before a row earns the scoring kernel
+        self.gate_block_min = int(gate_block_min) or GATE_BLOCK_MIN
+        # width-ladder cap for packed text rows (0 = full ladder); texts
+        # at or above the cap take the host oracle
+        self.row_width = int(row_width)
         self._device_failed_logged = False
-        self._scorer = None  # ops.ngram_score.DeviceScorer, built lazily
+        self._scorer = None  # ops.ngram_score.DeviceBytesScorer, lazy
         # flat phrase table: (license, phrase, weight)
         self.licenses = sorted(NORMALIZED_FINGERPRINTS)
         self.phrases: list[tuple[int, str]] = []
@@ -317,17 +334,23 @@ class LicenseClassifier:
         return out
 
     def _classify_batch_device(self, texts: list[str]) -> list[list[LicenseFinding]]:
-        """Device n-gram scoring: hash every text's word 5-grams host-side
-        into sorted int32 rows, score all rows against the HBM-resident
-        corpus-fingerprint table (ops/ngram_score), then finalize findings
-        on host for the rare texts where a license's potential confidence
-        clears the threshold.
+        """Raw-bytes device scoring: zero-padded uint8 text rows are the
+        ONLY thing that crosses the host→device link — tokenization,
+        5-gram hashing (the exact low-32 image of the host's int64
+        hashes), dedup, corpus binary search and credit accumulation all
+        run on device (ops/ngram_score.score_from_bytes). A cheap
+        two-lane shingle gate (8-byte windows → per-512-block density for
+        gram-scale evidence; 4-byte windows → short-fingerprint anchors)
+        runs on the same resident rows first so the scoring kernel only
+        ever sees the rare flagged rows. The host scorer stays the parity
+        oracle and the confirm rung: wide texts, gram-cap overflows and
+        threshold-grazing confidences resolve exactly on host.
 
-        Dispatch follows the ``TpuSecretScanner`` discipline: row counts
-        pad to a power-of-two bucket ladder (every shape compiles once)
-        and a depth-``DEVICE_PIPELINE_DEPTH`` pending queue keeps packing,
-        transfer and kernel execution overlapped, interleaving with any
-        concurrent secret batches on the same device queue.
+        Dispatch follows the ``TpuSecretScanner`` discipline: widths
+        bucket on a ladder (every kernel shape compiles once) and a
+        depth-``DEVICE_PIPELINE_DEPTH`` pending queue keeps transfer,
+        gate and scoring overlapped, interleaving with any concurrent
+        secret batches on the same device queue.
         """
         import time
         from collections import deque
@@ -336,124 +359,141 @@ class LicenseClassifier:
         from trivy_tpu.ops import ngram_score as ng
 
         ctx = obs.current()
-        # per-corpus-shard cost profile: each gate/score dispatch records
-        # its row-bucket rung (and the mesh data-parallel shard count) so
-        # the license bucket ladder is tunable from data like the secret one
+        # per-width cost profile: each gate/score dispatch records its
+        # width rung (and the mesh data-parallel shard count) so the
+        # license bucket ladder is tunable from data like the secret one
         prof = ctx.profile() if ctx.enabled else None
         if not hasattr(self, "_gate_keys"):
             self._build_scoring()
         scorer = self._device_scorer()
-        out: list[list[LicenseFinding]] = [[] for _ in texts]
-        whashes, word_text, keys, gt = self._batch_hashes(texts)
-        groups, overflow = ng.pack_gram_rows(
-            ng.fold32(keys), gt, len(texts)
-        ) if len(keys) else ([], [])
         table = scorer.table
         L = len(self.licenses)
+        out: list[list[LicenseFinding]] = [[] for _ in texts]
+        encoded = [t.encode("latin-1", "replace") for t in texts]
+        groups, wide = ng.pack_text_rows(encoded, max_width=self.row_width)
         # float32 device-accumulation slack: the fold only ever overcounts,
         # but f32 summation error is two-sided — the kernel's tree-reduce
         # keeps it ~1e-6 relative even for the largest corpora, so 1e-4
         # is a conservative band; gate/acceptance comparisons inside it
         # are settled by the exact host scorer below
         EPS = 1e-4
-        pending: deque = deque()  # stage-A gate dispatches in flight
-        cand_rows: dict[int, list[np.ndarray]] = {}  # T -> candidate rows
-        cand_tis: dict[int, list[np.ndarray]] = {}
-
-        def fetch_gate() -> None:
-            dev, rows_p, tis = pending.popleft()
-            t0 = time.perf_counter()
-            with ctx.span("license.device_wait"):
-                counts = np.asarray(dev)[: len(tis)]
-            if prof is not None:
-                prof.bucket_dispatch(
-                    f"license.gate:{rows_p.shape[0]}x{dp}",
-                    len(tis), time.perf_counter() - t0,
-                )
-            sel = np.nonzero(counts > 0)[0]
-            if len(sel):
-                T = rows_p.shape[1]
-                cand_rows.setdefault(T, []).append(rows_p[sel])
-                cand_tis.setdefault(T, []).append(tis[sel])
-
+        block_min = max(1, int(self.gate_block_min))
+        anchor_min = max(1, int(table.gate.anchor_min))
         dp = max(1, scorer.data_parallelism)
-
-        def bucket_rows(n: int) -> int:
-            b = max(8, dp)
-            while b < n:
-                b *= 2
-            return -(-b // dp) * dp  # non-power-of-two meshes
-
-        def pad_rows(part: np.ndarray, b: int) -> np.ndarray:
-            if b == len(part):
-                return part
-            pad = np.full(
-                (b - len(part), part.shape[1]), ng.PAD_KEY, np.int32
-            )
-            return np.concatenate([part, pad])
-
-        # stage A: cheap corpus-intersection gate over every row — ~99% of
-        # scanned files share no gram with any license text, so the
-        # expensive credit-gather kernel below only ever sees the rest
-        for rows, tis in groups:
-            for off in range(0, len(rows), MAX_DEVICE_ROWS):
-                part_t = tis[off : off + MAX_DEVICE_ROWS]
-                part = pad_rows(
-                    rows[off : off + MAX_DEVICE_ROWS],
-                    bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
-                )
-                faults.check("device.dispatch", key="license")
-                with ctx.span("license.dispatch"):
-                    pending.append((scorer.gate(part), part, part_t))
-                ctx.sample("license.queue_depth", len(pending))
-                if len(pending) >= DEVICE_PIPELINE_DEPTH:
-                    fetch_gate()
-        while pending:
-            fetch_gate()
-
-        # stage B: full credit scoring for the flagged rows only; scores
-        # accumulate compactly per gated text (never a dense
-        # [n_texts, n_licenses] matrix — the header analyzer batches every
-        # source file of a scan into one call)
-        spending: deque = deque()
+        pending: deque = deque()  # shingle-gate dispatches in flight
+        spending: deque = deque()  # scoring dispatches in flight
         acc: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        anchor_tis: set[int] = set()  # rows whose 4-byte anchor lane fired
+        host_tis = set(wide)  # host-oracle rung: wide + overflow rows
 
         def fetch_score() -> None:
-            dev, tis, n_rows = spending.popleft()
-            fw_d, pp_d = dev
+            dev, tis, keep, n_rows, width = spending.popleft()
+            fw_d, pp_d, nu_d = dev
             t0 = time.perf_counter()
             with ctx.span("license.device_wait"):
                 fw_np = np.asarray(fw_d, dtype=np.float64)
                 pp_np = np.asarray(pp_d, dtype=np.float64)
+                nu_np = np.asarray(nu_d)
             if prof is not None:
                 prof.bucket_dispatch(
-                    f"license.score:{n_rows}x{dp}",
+                    f"license.score:{n_rows}x{width}x{dp}",
+                    len(keep), time.perf_counter() - t0,
+                )
+            ctx.count("license.score_rows", len(keep))
+            cap = scorer.gram_cap(width)
+            for i in keep.tolist():
+                ti = int(tis[i])
+                if int(nu_np[i]) > cap:
+                    # more unique grams than the kernel's sort window —
+                    # the device score would silently undercount
+                    host_tis.add(ti)
+                else:
+                    acc[ti] = (fw_np[i, :L], pp_np[i, :L])
+
+        def dispatch_score(rows_dev, tis, flag_idx, width: int) -> None:
+            n = len(flag_idx)
+            if scorer.mesh is not None or 2 * n >= len(tis):
+                # dense chunk (or sharded rows): score the resident batch
+                # whole — no gather, no re-upload, one compiled shape
+                sel_dev, sel_tis, keep = rows_dev, tis, flag_idx
+                n_rows = int(rows_dev.shape[0])
+            else:
+                b = 8
+                while b < n:
+                    b *= 2
+                sel_dev = scorer.take_rows(
+                    rows_dev, flag_idx.astype(np.int32), b
+                )
+                sel_tis = tis[flag_idx]
+                keep = np.arange(n)
+                n_rows = b
+            faults.check("device.dispatch", key="license")
+            with ctx.span("license.dispatch"):
+                spending.append((
+                    scorer.score_from_bytes(sel_dev, width),
+                    sel_tis, keep, n_rows, width,
+                ))
+            ctx.sample(
+                "license.queue_depth", len(pending) + len(spending)
+            )
+            if len(spending) >= DEVICE_PIPELINE_DEPTH:
+                fetch_score()
+
+        def fetch_gate() -> None:
+            dev, rows_dev, tis, width = pending.popleft()
+            blk_d, ah_d, _nb_d = dev
+            t0 = time.perf_counter()
+            with ctx.span("license.device_wait"):
+                blk = np.asarray(blk_d)[: len(tis)]
+                ah = np.asarray(ah_d)[: len(tis)]
+            if prof is not None:
+                prof.bucket_dispatch(
+                    f"license.gate:{blk.shape[0]}x{width}x{dp}",
                     len(tis), time.perf_counter() - t0,
                 )
-            for i, ti in enumerate(tis.tolist()):
-                acc[ti] = (fw_np[i, :L], pp_np[i, :L])
+            anchor_tis.update(
+                int(tis[i]) for i in np.nonzero(ah >= anchor_min)[0]
+            )
+            flag_idx = np.nonzero(blk.max(axis=1) >= block_min)[0]
+            if len(flag_idx):
+                dispatch_score(rows_dev, tis, flag_idx, width)
 
-        for T in sorted(cand_rows):
-            rows = np.concatenate(cand_rows[T])
-            tis = np.concatenate(cand_tis[T])
-            for off in range(0, len(rows), MAX_DEVICE_ROWS):
-                part_t = tis[off : off + MAX_DEVICE_ROWS]
-                part = pad_rows(
-                    rows[off : off + MAX_DEVICE_ROWS],
-                    bucket_rows(min(MAX_DEVICE_ROWS, len(rows) - off)),
-                )
+        # one pass per width bucket: upload a row chunk (the arena-slab
+        # traffic — the only per-scan link bytes), gate it while the next
+        # chunk packs, chain flagged rows straight into scoring
+        for width in sorted(groups):
+            rows, tis = groups[width]
+            rung = scorer.rows_per_dispatch(width)
+            for off in range(0, len(rows), rung):
+                part = rows[off : off + rung]
+                part_t = tis[off : off + rung]
+                if len(part) < rung:
+                    part = np.concatenate([
+                        part,
+                        np.zeros((rung - len(part), width), np.uint8),
+                    ])
                 faults.check("device.dispatch", key="license")
+                ctx.count("license.bytes_uploaded", part.nbytes)
                 with ctx.span("license.dispatch"):
-                    spending.append((scorer(part), part_t, len(part)))
-                ctx.sample("license.queue_depth", len(spending))
-                if len(spending) >= DEVICE_PIPELINE_DEPTH:
-                    fetch_score()
+                    rows_dev = scorer.put_rows(part)
+                    pending.append((
+                        scorer.gate_bytes(rows_dev, width),
+                        rows_dev, part_t, width,
+                    ))
+                ctx.sample(
+                    "license.queue_depth", len(pending) + len(spending)
+                )
+                if len(pending) >= DEVICE_PIPELINE_DEPTH:
+                    fetch_gate()
+        while pending:
+            fetch_gate()
         while spending:
             fetch_score()
 
-        # texts too large for one gram row take the host oracle directly
-        overflow_set = set(overflow)
-        for ti in overflow_set:
+        # texts at the width cap (and gram-cap overflows detected above)
+        # take the exact host oracle directly
+        overflow_set = set(host_tis)
+        for ti in sorted(overflow_set):
             out[ti] = self.classify(texts[ti])
 
         # candidate gate on device scores: a license is worth finalizing
@@ -487,31 +527,25 @@ class LicenseClassifier:
                 norm_cache[ti] = normalize(texts[ti])
             return norm_cache[ti]
 
-        # short-phrase anchor lane stays host-side (device rows carry gram
-        # keys only; single-word anchors gate here exactly as in the host
-        # batch path)
-        if self._short_gate and len(whashes):
-            wb = self._anchor_bloom[whashes & self._BLOOM_MASK]
-            surv_idx = np.nonzero(wb)[0]
-            if len(surv_idx):
-                sh = whashes[surv_idx]
-                ap = np.searchsorted(self._anchor_sorted, sh)
-                ap[ap >= len(self._anchor_sorted)] = 0
-                exact = self._anchor_sorted[ap] == sh
-                seen: set[tuple[int, int]] = set()
-                for wi, ai in zip(
-                    surv_idx[exact].tolist(), ap[exact].tolist()
-                ):
-                    ti = int(word_text[wi])
-                    if (ti, ai) in seen:
-                        continue
-                    seen.add((ti, ai))
-                    for gi in self._anchor_gates[
-                        self._anchor_off[ai] : self._anchor_off[ai + 1]
-                    ].tolist():
-                        li, ph, _anchor = self._short_gate[gi]
-                        if li not in by_text.get(ti, ()) and ph in get_norm(ti):
-                            by_text.setdefault(ti, set()).add(li)
+        # short-phrase anchor lane: the device's 4-byte shingle counter
+        # (sound floor: every short fingerprint survives whitespace
+        # mangling with >= anchor_min robust windows) flags the rows that
+        # may contain one; the exact substring check settles it here, and
+        # an unscored row with a real phrase hit takes the host oracle —
+        # the same confirm-rung shape as the secret scanner
+        if self._short_gate:
+            for ti in sorted(anchor_tis - overflow_set):
+                norm = get_norm(ti)
+                matched = {
+                    li for li, ph, _anchor in self._short_gate if ph in norm
+                }
+                if not matched:
+                    continue
+                if ti in acc:
+                    by_text.setdefault(ti, set()).update(matched)
+                else:
+                    overflow_set.add(ti)
+                    out[ti] = self.classify(texts[ti])
 
         with ctx.span("license.finalize"):
             for ti, cands in by_text.items():
@@ -546,25 +580,48 @@ class LicenseClassifier:
         return out
 
     def _device_scorer(self):
-        """Process-cached device scorer with the corpus table resident in
-        device memory across calls, scans and classifier instances."""
+        """Process-cached raw-bytes device scorer with the corpus table,
+        shingle blooms and anchor floor resident in device memory across
+        calls, scans and classifier instances."""
         if self._scorer is None:
+            from trivy_tpu.licensing.corpus_texts import FULL_TEXTS
             from trivy_tpu.ops import ngram_score as ng
 
             if not hasattr(self, "_gate_keys"):
                 self._build_scoring()
+            # shingle-gate corpus: raw + normalized full texts (the gate
+            # sees raw file bytes, so both spellings of every license must
+            # populate the bloom) plus every gram-bearing long phrase;
+            # anchor corpus: the short fingerprints the substring lane
+            # must never miss
+            gate_texts: list[str] = []
+            for lic in sorted(FULL_TEXTS):
+                gate_texts.append(FULL_TEXTS[lic])
+                gate_texts.append(normalize(FULL_TEXTS[lic]))
+            short_set = {ph for _li, ph, _a in self._short_gate}
+            gate_texts.extend(
+                ph for _li, ph in self.phrases if ph not in short_set
+            )
+            anchor_texts = sorted(short_set)
 
             def build(model_shards: int):
-                return ng.build_corpus_table(
+                return ng.build_corpus_table32(
                     self.licenses,
                     self._full_keys,
                     self._full_weights,
                     self._phrase_keys,
                     self._phrase_short,
+                    gate_texts,
+                    anchor_texts,
+                    self._LUT,
+                    int(self._P1),
+                    int(self._P2),
+                    int(self._HASH_P),
+                    self._NGRAM,
                     model_shards=model_shards,
                 )
 
-            self._scorer = ng.get_scorer(build, mesh=self.mesh)
+            self._scorer = ng.get_bytes_scorer(build, mesh=self.mesh)
         return self._scorer
 
     # -- shared scoring -----------------------------------------------------

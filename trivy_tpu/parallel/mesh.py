@@ -650,6 +650,61 @@ def sharded_gate_fn(gate_fn, mesh: Mesh):
     return run
 
 
+def sharded_bytes_gate_fn(gate_fn, mesh: Mesh):
+    """Shard the raw-bytes shingle gate (ops/ngram_score
+    build_bytes_gate_fn): uint8 text rows over 'data', the two shingle
+    blooms replicated (they are corpus-global, not per-shard); the
+    per-row outputs come back partitioned over 'data' only."""
+    fn = jax.jit(
+        _shard_map(
+            gate_fn,
+            mesh=mesh,
+            in_specs=(P("data", None), P(), P()),
+            out_specs=(P("data", None), P("data"), P("data")),
+        )
+    )
+
+    def run(rows, bloom8, bloom4):
+        return fn(jnp.asarray(rows), bloom8, bloom4)
+
+    run.data_parallelism = int(mesh.shape["data"])
+    return run
+
+
+def sharded_bytes_score_fn(score_fn, mesh: Mesh):
+    """Shard the raw-bytes scoring kernel (ops/ngram_score
+    build_bytes_score_fn): uint8 rows over 'data', corpus shards over
+    'model'. Score pairs reassemble as [B, m*Ls] like sharded_score_fn;
+    the third output (per-row unique-gram count, corpus-independent and
+    identical on every model shard) stays partitioned over 'data' only.
+    """
+    def body(rows, keys, credit):
+        full_w, phrase, n_uniq = score_fn(rows, keys, credit)
+        # n_uniq is replicated across 'model'; collapse it explicitly so
+        # the out_spec P("data") is sound under shard_map's checker.
+        n_uniq = jax.lax.pmax(n_uniq, axis_name="model")
+        return full_w, phrase, n_uniq
+
+    fn = jax.jit(
+        _shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(
+                P("data", None),  # uint8 text rows [B/d, W]
+                P("model", None),  # corpus keys [m, Ku] -> local [1, Ku]
+                P("model", None, None),  # credit [m, Ku, 2*Ls]
+            ),
+            out_specs=(P("data", "model"), P("data", "model"), P("data")),
+        )
+    )
+
+    def run(rows, keys, credit):
+        return fn(jnp.asarray(rows), keys, credit)
+
+    run.data_parallelism = int(mesh.shape["data"])
+    return run
+
+
 def hit_counts_psum(match_fn, mesh: Mesh):
     """Per-rule global hit counts over a sharded batch, reduced with psum
     over ICI — the telemetry/all-gather path exercised by dryrun_multichip."""

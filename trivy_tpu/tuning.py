@@ -64,7 +64,8 @@ DEFAULT_TUNING_INTERVAL = 0.5
 # knobs TuningConfig owns; order is the canonical display/serialize order
 KNOBS = (
     "feed_streams", "inflight", "arena_slabs", "bucket_rungs", "parallel",
-    "fleet_inflight", "dedup_store_mb",
+    "fleet_inflight", "dedup_store_mb", "license_gate_block_min",
+    "license_row_width",
 )
 
 # env spellings per knob (the feed-path pair predates this module and is
@@ -77,6 +78,8 @@ _ENV_NAMES = {
     "parallel": "TRIVY_TPU_PARALLEL",
     "fleet_inflight": "TRIVY_TPU_FLEET_INFLIGHT",
     "dedup_store_mb": "TRIVY_TPU_DEDUP_STORE_MB",
+    "license_gate_block_min": "TRIVY_TPU_LICENSE_GATE_BLOCK_MIN",
+    "license_row_width": "TRIVY_TPU_LICENSE_ROW_WIDTH",
 }
 
 
@@ -141,6 +144,8 @@ class TuningConfig:
     parallel: int = 0       # host read/analyze workers (0 = DEFAULT_PARALLEL)
     fleet_inflight: int = 0  # shard jobs in flight per fleet replica (0 = 2)
     dedup_store_mb: int = 0  # dedup hit-store LRU byte budget (0 = 32 MB)
+    license_gate_block_min: int = 0  # shingle-gate density floor (0 = 16)
+    license_row_width: int = 0  # license row-width ladder cap (0 = full)
     # compressed slab wire format (secret/compress.py). Modes, not int
     # optima — like controller/tuning_interval they resolve CLI > env >
     # default with provenance, but never from an autotune record
@@ -163,6 +168,8 @@ class TuningConfig:
             "parallel": self.parallel,
             "fleet_inflight": self.fleet_inflight,
             "dedup_store_mb": self.dedup_store_mb,
+            "license_gate_block_min": self.license_gate_block_min,
+            "license_row_width": self.license_row_width,
             "compress": self.compress,
             "compress_min_ratio": self.compress_min_ratio,
             "controller": self.controller,
@@ -293,6 +300,8 @@ def resolve_tuning(opts: dict | None = None, env: dict | None = None,
         "parallel": "parallel",
         "fleet_inflight": "fleet_inflight",
         "dedup_store_mb": "secret_dedup_mb",
+        "license_gate_block_min": "license_gate_block_min",
+        "license_row_width": "license_row_width",
     }
     if autotune_path is None:
         autotune_path = opts.get("tuning_file") or env.get(ENV_TUNING_FILE)
