@@ -1566,6 +1566,10 @@ def bench_distributed(rng) -> dict:
                 _kill_fleet(procs)
             mbs[n] = total_mb / dt
             stats[n] = art.stats()
+            stats[n]["telemetry"] = art.telemetry()
+            stats[n]["verdict"] = dict(
+                art.coordinator.verdict if art.coordinator else {}
+            )
             if [r.to_dict() for r in report.results] != want_results:
                 raise RuntimeError(
                     f"distributed_scan findings diverged from the "
@@ -1589,6 +1593,26 @@ def bench_distributed(rng) -> dict:
     achievable = max(1, min(n_max, cpus))
     fabric_eff = mbs[n_max] / (achievable * mbs[n_min])
     s_max = stats[n_max]
+    # fleet telemetry summary for the largest fleet: per-replica busy
+    # ratio p50 from the poller's scraped series, plus the idle share of
+    # the efficiency verdict ((idle + dead) worker capacity / total) —
+    # the guarded lower-is-better coordination-waste number
+    verdict = s_max.get("verdict") or {}
+    idle_share = (
+        round(
+            sum(
+                (v.get("idle", 0.0) + v.get("dead", 0.0)) / 100.0
+                for v in verdict.values()
+            ) / len(verdict), 4,
+        )
+        if verdict else None
+    )
+    tel_replicas = (s_max.get("telemetry") or {}).get("replicas") or {}
+    busy_p50 = {
+        host: (rep.get("summary", {}).get("device_busy_ratio") or {})
+        .get("p50", 0.0)
+        for host, rep in sorted(tel_replicas.items())
+    }
     return {
         "metric": "distributed_scan",
         "value": round(mbs[n_max], 2),
@@ -1606,6 +1630,17 @@ def bench_distributed(rng) -> dict:
             ),
             "redispatches": s_max["redispatches"],
             "shards": s_max["shards"],
+            "fleet_telemetry": {
+                "interval_s": (s_max.get("telemetry") or {}).get(
+                    "interval_s"
+                ),
+                "replica_busy_p50": busy_p50,
+                "headroom": {
+                    host: rep.get("headroom")
+                    for host, rep in sorted(tel_replicas.items())
+                },
+                "fleet_idle_share": idle_share,
+            },
             "parity": "ok",
         },
     }
@@ -1960,9 +1995,14 @@ def _smoke_fleet_off() -> str | None:
             "fleet-off reps imported trivy_tpu.fleet — the fabric must "
             "not even load without --fleet"
         )
+    if "trivy_tpu.fleet.telemetry" in sys.modules:
+        return (
+            "fleet-off reps imported trivy_tpu.fleet.telemetry — the "
+            "telemetry plane must not even load without --fleet"
+        )
     threads = [
         t.name for t in _threading.enumerate()
-        if t.name.startswith("fleet-worker")
+        if t.name.startswith(("fleet-worker", "fleet-telemetry"))
     ]
     if threads:
         return f"fleet-off reps allocated coordinator thread(s): {threads}"
@@ -1976,8 +2016,14 @@ def _smoke_fleet_off() -> str | None:
         )
     from trivy_tpu.obs import metrics as obs_metrics
 
-    if 'device="fleet:' in obs_metrics.REGISTRY.render():
+    rendered = obs_metrics.REGISTRY.render()
+    if 'device="fleet:' in rendered:
         return "fleet-off reps registered fleet breaker gauge rows"
+    if "trivy_tpu_fleet_" in rendered:
+        return (
+            "fleet-off reps registered trivy_tpu_fleet_* telemetry "
+            "gauges — the poller must allocate nothing when off"
+        )
     return None
 
 
@@ -2672,6 +2718,10 @@ LOWER_IS_BETTER = {
     "license_link_bytes_per_text_byte",
     "saturation_p95_ms",
     "wire_compression_ratio",
+    # share of fleet worker capacity spent idle or dead (the efficiency
+    # verdict's non-busy, non-coordinator-stalled buckets): rising idle
+    # means the coordinator is feeding replicas worse
+    "fleet_idle_share",
 }
 
 # utilization telemetry (sampled during the traced rep): a drop here fails
@@ -2749,6 +2799,12 @@ def _metric_values(doc: dict) -> dict:
             eff = (m.get("detail") or {}).get("scaling_efficiency_4x")
             if isinstance(eff, (int, float)):
                 out["scaling_efficiency_4x"] = float(eff)
+            # and the telemetry plane's coordination-waste share
+            # (lower-is-better): idle+dead capacity across the fleet
+            idle = ((m.get("detail") or {}).get("fleet_telemetry") or {}
+                    ).get("fleet_idle_share")
+            if isinstance(idle, (int, float)):
+                out["fleet_idle_share"] = float(idle)
         if m.get("metric") == "license_classify_throughput":
             # raw-bytes device scoring exists to keep the license leg off
             # the host link: guard its per-text-byte upload cost the same

@@ -38,9 +38,9 @@ def _disarm_faults():
 def _assert_no_fleet_threads():
     left = [
         t.name for t in threading.enumerate()
-        if t.name.startswith("fleet-worker")
+        if t.name.startswith(("fleet-worker", "fleet-telemetry"))
     ]
-    assert not left, f"leaked fleet worker thread(s): {left}"
+    assert not left, f"leaked fleet worker/telemetry thread(s): {left}"
 
 
 def make_tree(base, n_dirs=12) -> str:
@@ -123,6 +123,11 @@ def _single_host_image(path, scanners=("secret",)):
 
 def _fleet_scan(kind, target, hosts, scanners=("secret",), **cfg_kw):
     cfg_kw.setdefault("speculate", 0.0)
+    # fabric tests run the telemetry plane off by default so dead-replica
+    # legs don't pay scrape deadlines and the process-default context
+    # never grows a fleet doc; test_fleet_telemetry.py owns poller-on
+    # coverage and opts in explicitly
+    cfg_kw.setdefault("telemetry_interval", 0.0)
     cfg = FleetConfig(hosts=list(hosts), **cfg_kw)
     cache = new_cache("memory", None)
     so = ScanOptions(scanners=list(scanners))

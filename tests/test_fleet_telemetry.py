@@ -1,0 +1,480 @@
+"""Fleet telemetry plane (trivy_tpu/fleet/telemetry.py + obs extensions):
+Prometheus exposition parser⇄renderer round trip (property-tested,
+including the label-value and HELP escaping rules), replica headroom
+scoring, poller lifecycle (clean thread teardown, dead-replica resilience,
+interval-0 zero allocation, disjoint gauge label sets for concurrent
+fleets), aggregated fleet surfaces (metrics/timeseries ``fleet`` blocks,
+merged-timeline counter tracks, heartbeat fragment), the per-replica
+efficiency verdict (buckets sum to 100), and /metrics + /healthz staying
+200 through a drain."""
+
+import random
+import string
+import threading
+import time
+
+import pytest
+
+from tests.test_fleet import (
+    _assert_no_fleet_threads,
+    _fleet,
+    _fleet_scan,
+    _results,
+    _shutdown,
+    _single_host_fs,
+    make_tree,
+)
+
+from trivy_tpu import obs
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs.metrics import ParseError, Registry, parse_text
+
+
+def _assert_no_telemetry_threads():
+    left = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith("fleet-telemetry")
+    ]
+    assert not left, f"leaked fleet telemetry thread(s): {left}"
+
+
+def _fleet_gauge_rows():
+    return [
+        line for line in obs_metrics.REGISTRY.render().splitlines()
+        if line.startswith("trivy_tpu_fleet_") and not line.startswith("#")
+    ]
+
+
+# -- parser ⇄ renderer round trip ---------------------------------------------
+
+
+class TestParseText:
+    def test_round_trip_basic(self):
+        reg = Registry()
+        reg.counter("t_requests_total", "total requests").inc(3)
+        reg.gauge("t_depth", "queue depth", labelnames=("tenant",)).set(
+            7, tenant="acme"
+        )
+        reg.histogram("t_wait", "wait", buckets=(0.1, 1.0)).observe(0.5)
+        out = parse_text(reg.render())
+        assert out["t_requests_total"].value() == 3
+        assert out["t_requests_total"].kind == "counter"
+        assert out["t_depth"].value(tenant="acme") == 7
+        assert out["t_wait_bucket"].value(le="1.0") == 1
+        assert out["t_wait_bucket"].value(le="+Inf") == 1
+        assert out["t_wait_count"].first() == 1
+        # histogram sample families inherit the base declaration's kind
+        assert out["t_wait_bucket"].kind == "histogram"
+        assert out["t_wait_sum"].kind == "histogram"
+
+    def test_round_trip_label_escaping(self):
+        reg = Registry()
+        g = reg.gauge("t_esc", "escapes", labelnames=("v",))
+        nasty = ['a"b', "a\\b", "a\nb", 'mix\\"of\nall', "replica:10.0.0.1"]
+        for i, v in enumerate(nasty):
+            g.set(float(i), v=v)
+        out = parse_text(reg.render())
+        for i, v in enumerate(nasty):
+            assert out["t_esc"].value(v=v) == float(i), repr(v)
+
+    def test_round_trip_help_escaping(self):
+        reg = Registry()
+        reg.gauge(
+            't_h', 'has "quotes", a \\ backslash\nand a newline'
+        ).set(1)
+        out = parse_text(reg.render())
+        assert out["t_h"].help == \
+            'has "quotes", a \\ backslash\nand a newline'
+
+    def test_round_trip_property(self):
+        """Randomized registries survive render → parse exactly."""
+        rng = random.Random(1234)
+        alphabet = string.ascii_letters + string.digits + '\\"\n :{},='
+        for _ in range(25):
+            reg = Registry()
+            want = {}
+            for gi in range(rng.randint(1, 4)):
+                name = f"t_prop_{gi}"
+                g = reg.gauge(name, "p", labelnames=("k",))
+                for _ in range(rng.randint(1, 4)):
+                    lv = "".join(
+                        rng.choice(alphabet)
+                        for _ in range(rng.randint(0, 12))
+                    )
+                    v = round(rng.uniform(-1e6, 1e6), 6)
+                    g.set(v, k=lv)
+                    want[(name, lv)] = v
+            out = parse_text(reg.render())
+            for (name, lv), v in want.items():
+                assert out[name].value(k=lv) == v, repr(lv)
+
+    def test_concatenated_registries(self):
+        # the replica /metrics body is two registries concatenated;
+        # duplicate TYPE/HELP declarations must accumulate, not fail
+        a, b = Registry(), Registry()
+        a.gauge("t_cat", "x", labelnames=("r",)).set(1, r="a")
+        b.gauge("t_cat", "x", labelnames=("r",)).set(2, r="b")
+        out = parse_text(a.render() + b.render())
+        assert out["t_cat"].value(r="a") == 1
+        assert out["t_cat"].value(r="b") == 2
+
+    def test_malformed_is_loud(self):
+        with pytest.raises(ParseError):
+            parse_text("t_bad{open=\"x\n")  # unterminated label set
+        with pytest.raises(ParseError):
+            parse_text("t_bad notanumber")
+        with pytest.raises(ParseError):
+            parse_text('{="v"} 1')
+
+    def test_inf_and_declared_empty_families(self):
+        text = (
+            "# TYPE t_empty gauge\n"
+            "# HELP t_empty declared but sampleless\n"
+            't_b_bucket{le="+Inf"} 4\n'
+        )
+        out = parse_text(text)
+        assert out["t_empty"].samples == []
+        assert out["t_b_bucket"].value(le="+Inf") == 4
+
+
+# -- headroom scoring ---------------------------------------------------------
+
+
+class TestReplicaHealth:
+    def test_headroom_scoring(self):
+        from trivy_tpu.fleet.telemetry import ReplicaHealth
+
+        rh = ReplicaHealth("h:1")
+        assert rh.headroom() == 0.0  # never scraped -> unreachable
+        rh.reachable = True
+        rh.last = {"device_busy_ratio": 0.0, "queue_depth": 0.0}
+        assert rh.headroom() == 1.0
+        rh.last = {"device_busy_ratio": 0.5, "queue_depth": 1.0}
+        assert rh.headroom() == pytest.approx(0.25)
+        rh.last["arena_free_slabs"] = 0.0  # starved arena halves the score
+        assert rh.headroom() == pytest.approx(0.125)
+        rh.breaker_open = True
+        assert rh.headroom() == 0.0
+
+    def test_note_scrape_folds_gauges(self):
+        from trivy_tpu.fleet.telemetry import ReplicaHealth
+
+        reg = Registry()
+        reg.gauge("trivy_tpu_link_mbs", "l").set(123.0)
+        reg.gauge(
+            "trivy_tpu_device_busy_ratio", "b", labelnames=("device",)
+        ).set(0.4, device="tpu:0")
+        reg.gauge(
+            "trivy_tpu_admission_queue_depth", "q", labelnames=("tenant",)
+        ).set(2, tenant="a")
+        reg.gauge(
+            "trivy_tpu_admission_queue_depth", "q", labelnames=("tenant",)
+        ).set(3, tenant="b")
+        rh = ReplicaHealth("h:1")
+        rh.note_scrape(0.5, parse_text(reg.render()))
+        assert rh.last["link_mbs"] == 123.0
+        assert rh.last["device_busy_ratio"] == 0.4
+        assert rh.last["queue_depth"] == 5.0  # summed across tenants
+        assert rh.series.latest("link_mbs") == 123.0
+        assert rh.headroom() == pytest.approx((1 - 0.4) / (1 + 5), abs=1e-4)
+
+
+# -- knob resolution ----------------------------------------------------------
+
+
+class TestTelemetryKnob:
+    def test_resolves_through_tuning_env(self):
+        from trivy_tpu.tuning import resolve_tuning
+
+        cfg = resolve_tuning(
+            opts={}, env={"TRIVY_TPU_FLEET_TELEMETRY_INTERVAL": "2.5"},
+            autotune_path="",
+        )
+        assert cfg.fleet_telemetry_interval == 2.5
+
+    def test_explicit_zero_cli_wins_over_env(self):
+        from trivy_tpu.tuning import resolve_tuning
+
+        cfg = resolve_tuning(
+            opts={"fleet_telemetry_interval": 0.0},
+            env={"TRIVY_TPU_FLEET_TELEMETRY_INTERVAL": "2.5"},
+            autotune_path="",
+        )
+        assert cfg.fleet_telemetry_interval == 0.0
+
+    def test_fleet_config_resolution(self):
+        from trivy_tpu.fleet.coordinator import FleetConfig
+        from trivy_tpu.tuning import TuningConfig
+
+        cfg = FleetConfig.from_opts(
+            {"fleet": "h:1"}, tuning=TuningConfig(fleet_telemetry_interval=3.0)
+        )
+        assert cfg.telemetry_interval == 3.0
+        cfg = FleetConfig.from_opts(
+            {"fleet": "h:1", "fleet_telemetry_interval": 0.0},
+            tuning=TuningConfig(fleet_telemetry_interval=3.0),
+        )
+        assert cfg.telemetry_interval == 0.0  # explicit CLI zero wins
+
+    def test_invalid_interval_rejected(self):
+        from trivy_tpu.tuning import resolve_tuning
+
+        with pytest.raises(ValueError):
+            resolve_tuning(
+                opts={"fleet_telemetry_interval": "-1"}, env={},
+                autotune_path="",
+            )
+
+
+# -- poller lifecycle + aggregated surfaces (2-replica e2e) -------------------
+
+
+class TestPollerEndToEnd:
+    def test_two_replica_scan_all_surfaces(self, tmp_path):
+        root = make_tree(tmp_path)
+        single = _single_host_fs(root)
+        httpds, hosts = _fleet(2)
+        try:
+            with obs.scan_context(name="fleet-tel", enabled=True) as ctx:
+                report, art = _fleet_scan(
+                    "fs", root, hosts, telemetry_interval=0.05
+                )
+        finally:
+            _shutdown(httpds)
+        assert _results(report) == _results(single)
+        # threads and gauges are gone after the fan-out
+        _assert_no_fleet_threads()
+        _assert_no_telemetry_threads()
+        assert _fleet_gauge_rows() == []
+        # the fleet doc landed on the context with one entry per replica
+        fleet = ctx.fleet
+        assert fleet and set(fleet["replicas"]) == set(hosts)
+        for host, rep in fleet["replicas"].items():
+            assert rep["scrapes"] > 0
+            assert 0.0 <= rep["headroom"] <= 1.0
+            assert "series" in rep and "summary" in rep
+        # metrics_dict: fleet block with per-replica headroom, no points
+        from trivy_tpu.obs import export as obs_export
+
+        mdoc = obs_export.metrics_dict(ctx)
+        assert set(mdoc["fleet"]["replicas"]) == set(hosts)
+        for rep in mdoc["fleet"]["replicas"].values():
+            assert "headroom" in rep and "series" not in rep
+        # timeseries_dict carries the full points
+        tdoc = obs_export.timeseries_dict(ctx)
+        assert set(tdoc["fleet"]["replicas"]) == set(hosts)
+        for rep in tdoc["fleet"]["replicas"].values():
+            assert rep["series"], "expected per-replica series points"
+        # ONE merged Perfetto timeline: per-replica counter tracks render
+        # as distinct processes beyond the local + remote-shard pids
+        events = obs_export.chrome_trace_events(ctx)
+        counter_pids = {
+            e["pid"] for e in events
+            if e.get("ph") == "C" and e["pid"] >= 2 + len(ctx.remote)
+        }
+        assert len(counter_pids) == len(hosts)
+        names = {
+            e["args"]["name"] for e in events
+            if e.get("ph") == "M" and e.get("name") == "process_name"
+        }
+        for host in hosts:
+            assert any(host in n for n in names)
+        # per-shard cost attribution + efficiency verdict sum to 100
+        prof = ctx.merged_profile_dict()
+        shards = prof["fleet"]["shards"]
+        assert shards and all(s["replica"] in hosts for s in shards)
+        assert sum(s["bytes"] for s in shards) > 0
+        verdict = prof["fleet"]["replicas"]
+        assert set(verdict) == set(hosts)
+        for host, v in verdict.items():
+            total = (v["busy"] + v["idle"] + v["stalled_on_coordinator"]
+                     + v["dead"])
+            assert total == pytest.approx(100.0, abs=1e-6), (host, v)
+            assert v["busy"] > 0.0  # every replica did real work
+        # the report renders the fleet efficiency table
+        import io
+
+        buf = io.StringIO()
+        ctx.report(out=buf)
+        assert "fleet efficiency" in buf.getvalue()
+
+    def test_interval_zero_allocates_nothing(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=4)
+        httpds, hosts = _fleet(2)
+        before = {t.name for t in threading.enumerate()}
+        try:
+            with obs.scan_context(name="tel-off", enabled=True) as ctx:
+                report, art = _fleet_scan(
+                    "fs", root, hosts, telemetry_interval=0.0
+                )
+                assert art.telemetry() == {}
+        finally:
+            _shutdown(httpds)
+        assert report.results
+        assert ctx.fleet is None
+        _assert_no_telemetry_threads()
+        assert _fleet_gauge_rows() == []
+        # no telemetry thread ever appeared (poller never started)
+        after = {t.name for t in threading.enumerate()} - before
+        assert not any(n.startswith("fleet-telemetry") for n in after)
+
+    def test_dead_replica_scrape_never_kills_ticks(self):
+        """A poller over one live and one vacant port keeps ticking: the
+        dead replica reports breaker-open headroom-0, the live one scrapes
+        fine, and stop() retires every gauge row."""
+        from trivy_tpu.cache import new_cache
+        from trivy_tpu.fleet.coordinator import FleetConfig, FleetCoordinator
+        from trivy_tpu.fleet.telemetry import ReplicaPoller
+        from trivy_tpu.rpc.server import start_server
+        from trivy_tpu.scanner import ScanOptions
+
+        httpd, port = start_server(cache=new_cache("memory", None))
+        dead = "127.0.0.1:9"  # discard port: connection refused
+        hosts = [f"127.0.0.1:{port}", dead]
+        try:
+            cfg = FleetConfig(hosts=hosts, rpc_retries=0, rpc_deadline=1.0)
+            coord = FleetCoordinator(
+                cfg, ScanOptions(scanners=["secret"])
+            )
+            ctx = obs.TraceContext(name="tel-test", enabled=True)
+            poller = ReplicaPoller(coord, ctx, interval=0.05).start()
+            try:
+                time.sleep(0.3)
+                live, gone = poller.health[hosts[0]], poller.health[dead]
+                assert live.scrapes >= 2 and live.reachable
+                assert live.scrape_failures == 0
+                assert gone.scrapes >= 2 and not gone.reachable
+                assert gone.scrape_failures == gone.scrapes
+                assert gone.breaker_open and gone.headroom() == 0.0
+                # live gauges exist mid-flight, dead rows show breaker 1
+                rows = "\n".join(_fleet_gauge_rows())
+                assert f'trivy_tpu_fleet_breaker_open{{replica="{dead}"}} 1' \
+                    in rows
+                assert f'trivy_tpu_fleet_headroom{{replica="{dead}"}} 0' \
+                    in rows
+            finally:
+                poller.stop()
+            _assert_no_telemetry_threads()
+            assert _fleet_gauge_rows() == []
+            # stop is idempotent
+            poller.stop()
+        finally:
+            httpd.shutdown()
+
+    def test_concurrent_fleets_disjoint_gauge_rows(self):
+        from trivy_tpu.cache import new_cache
+        from trivy_tpu.fleet.coordinator import FleetConfig, FleetCoordinator
+        from trivy_tpu.fleet.telemetry import ReplicaPoller
+        from trivy_tpu.rpc.server import start_server
+        from trivy_tpu.scanner import ScanOptions
+
+        httpds, pollers, fleet_hosts = [], [], []
+        try:
+            for _ in range(2):
+                httpd, port = start_server(cache=new_cache("memory", None))
+                httpds.append(httpd)
+                hosts = [f"127.0.0.1:{port}"]
+                fleet_hosts.append(hosts)
+                coord = FleetCoordinator(
+                    FleetConfig(hosts=hosts),
+                    ScanOptions(scanners=["secret"]),
+                )
+                ctx = obs.TraceContext(name="tel-pair", enabled=True)
+                pollers.append(
+                    ReplicaPoller(coord, ctx, interval=0.05).start()
+                )
+            time.sleep(0.2)
+            rows = "\n".join(_fleet_gauge_rows())
+            for hosts in fleet_hosts:
+                assert f'replica="{hosts[0]}"' in rows
+            # stopping fleet A retires ONLY fleet A's label rows
+            pollers[0].stop()
+            rows = "\n".join(_fleet_gauge_rows())
+            assert f'replica="{fleet_hosts[0][0]}"' not in rows
+            assert f'replica="{fleet_hosts[1][0]}"' in rows
+        finally:
+            for p in pollers:
+                p.stop()
+            _shutdown(httpds)
+        assert _fleet_gauge_rows() == []
+        _assert_no_telemetry_threads()
+
+
+# -- heartbeat + live fragments -----------------------------------------------
+
+
+class TestFleetFragments:
+    def test_heartbeat_carries_fleet_fragment(self):
+        from trivy_tpu import log as tlog
+
+        ctx = obs.TraceContext(name="hb-test", enabled=True)
+        ctx.progress().note_walked(100, files=1)
+        ctx.progress().note_scanned(50, files=0)
+        ctx.fleet_status = lambda: {
+            "replicas": 2, "healthy": 1, "breaker_open": 1,
+            "fleet_mbs": 12.5, "shards_done": 3, "shards_total": 8,
+        }
+        hb = obs.heartbeat(tlog.logger("test"), "scan", interval=999)
+        hb._ctx = ctx
+        frag = hb._telemetry()
+        assert "fleet 3/8 shards" in frag
+        assert "1/2 healthy" in frag
+        assert "1 open" in frag
+        assert "12.5 MB/s" in frag
+
+    def test_live_line_carries_fleet_fragment(self):
+        from trivy_tpu.obs.timeseries import LiveProgress
+
+        ctx = obs.TraceContext(name="live-test", enabled=True)
+        ctx.fleet_live = lambda: "fleet[r0 80% 100MB/s q1 | r1 OPEN]"
+        line = LiveProgress(ctx).line()
+        assert "fleet[r0 80% 100MB/s q1 | r1 OPEN]" in line
+
+    def test_poller_live_fragment_format(self):
+        from trivy_tpu.fleet.telemetry import ReplicaHealth, ReplicaPoller
+
+        poller = ReplicaPoller.__new__(ReplicaPoller)
+        poller.hosts = ["a:1", "b:2"]
+        ok = ReplicaHealth("a:1")
+        ok.reachable = True
+        ok.last = {"device_busy_ratio": 0.8, "link_mbs": 99.6,
+                   "queue_depth": 2.0}
+        bad = ReplicaHealth("b:2")
+        bad.breaker_open = True
+        poller.health = {"a:1": ok, "b:2": bad}
+        assert poller.live_fragment() == "fleet[r0 80% 100MB/s q2 | r1 OPEN]"
+        st = poller.status()
+        assert st == {
+            "replicas": 2, "healthy": 1, "breaker_open": 1,
+            "fleet_mbs": 99.6,
+        }
+
+
+# -- monitoring must outlive admission (drain regression) ---------------------
+
+
+class TestDrainMonitoring:
+    def test_metrics_and_healthz_answer_200_while_draining(self):
+        import json
+        import urllib.request
+
+        from trivy_tpu.cache import new_cache
+        from trivy_tpu.rpc.server import start_server
+
+        httpd, port = start_server(cache=new_cache("memory", None))
+        base = f"http://127.0.0.1:{port}"
+        try:
+            httpd.service.draining = True
+            with urllib.request.urlopen(f"{base}/healthz") as resp:
+                assert resp.status == 200
+                assert json.load(resp)["Status"] == "draining"
+            with urllib.request.urlopen(f"{base}/metrics") as resp:
+                assert resp.status == 200
+                body = resp.read().decode()
+            # drain state is itself a scrapable gauge
+            assert parse_text(body)[
+                "trivy_tpu_server_draining"
+            ].first() == 1.0
+        finally:
+            httpd.service.draining = False
+            httpd.shutdown()

@@ -467,6 +467,15 @@ def fleet_flags() -> FlagGroup:
                       "past this x the median shard wall time is "
                       "speculatively re-dispatched to an idle replica, "
                       "first result wins (default 2.0; 0 disables)"),
+            Flag("fleet-telemetry-interval", default=None, value_type=float,
+                 config_name="fleet.telemetry-interval",
+                 validator=_interval_validator,
+                 help="replica health-poll cadence in seconds: the "
+                      "coordinator scrapes each replica's /metrics and "
+                      "live progress into per-replica headroom series "
+                      "(default 1.0; 0 disables the poller entirely — no "
+                      "thread, no fleet gauges; env "
+                      "TRIVY_TPU_FLEET_TELEMETRY_INTERVAL)"),
         ],
     )
 

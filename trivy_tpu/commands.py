@@ -60,6 +60,7 @@ def _resolve_tuning(opts):
         # only an EXPLICIT --tune is a CLI-level decision
         "tuning_controller": opts.get("tune") or None,
         "tuning_interval": opts.get("tuning_interval"),
+        "fleet_telemetry_interval": opts.get("fleet_telemetry_interval"),
     })
     obs.current().tuning = {"config": cfg.to_dict()}
     return cfg

@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 from trivy_tpu import faults, log, obs
 from trivy_tpu.fleet import FleetError, parse_fleet
 from trivy_tpu.fleet.plan import DEFAULT_SHARDS_PER_REPLICA
+from trivy_tpu.tuning import DEFAULT_FLEET_TELEMETRY_INTERVAL
 
 logger = log.logger("fleet:coordinator")
 
@@ -77,6 +78,9 @@ class FleetConfig:
     rpc_retries: int = 1  # replica-death detection must be fast — the
     rpc_deadline: float = 10.0  # coordinator's ladder is the real retry
     poll_s: float = RESULT_POLL_S
+    # replica health-poll cadence (fleet telemetry plane); 0 disables the
+    # poller entirely — no thread, no telemetry import, no fleet gauges
+    telemetry_interval: float = DEFAULT_FLEET_TELEMETRY_INTERVAL
 
     @classmethod
     def from_opts(cls, opts: dict, tuning=None) -> "FleetConfig":
@@ -101,10 +105,31 @@ class FleetConfig:
         )
         if speculate is not None:
             cfg.speculate = max(0.0, float(speculate))
+        # explicit CLI 0 must win over the tuning layer (0.0 is falsy, so
+        # no `or`-chain here): "telemetry off" is a decision, not absence
+        tiv = opts.get("fleet_telemetry_interval")
+        if tiv is None:
+            tiv = getattr(
+                tuning, "fleet_telemetry_interval",
+                DEFAULT_FLEET_TELEMETRY_INTERVAL,
+            )
+        cfg.telemetry_interval = max(0.0, float(tiv))
         return cfg
 
     def target_shards(self) -> int:
         return max(1, len(self.hosts) * self.shards_per_replica)
+
+
+def _normalize_100(buckets: dict[str, float]) -> dict[str, float]:
+    """Round efficiency buckets to one decimal so they sum to exactly
+    100.0 — rounding drift lands on the largest bucket, where a ±0.1
+    cannot mislead anyone."""
+    rounded = {k: round(max(0.0, v), 1) for k, v in buckets.items()}
+    drift = round(100.0 - sum(rounded.values()), 1)
+    if drift:
+        largest = max(rounded, key=lambda k: rounded[k])
+        rounded[largest] = round(rounded[largest] + drift, 1)
+    return rounded
 
 
 class _ShardState:
@@ -170,6 +195,20 @@ class FleetCoordinator:
         self._shards: list[_ShardState] = []
         self._durations: list[float] = []
         self._stop = False
+        # fleet telemetry inputs: jobs currently polling per replica (the
+        # poller scrapes their live progress) and per-replica attempt wall
+        # accounting for the efficiency verdict
+        self._active_jobs: dict[str, set[str]] = {h: set() for h in cfg.hosts}
+        self._host_busy: dict[str, float] = {h: 0.0 for h in cfg.hosts}
+        self._host_last_done: dict[str, float] = {}
+        self._run_started = 0.0
+        self.verdict: dict[str, dict] = {}  # set at fan-out end
+
+    def active_jobs(self, host: str) -> list[str]:
+        """Snapshot of the job ids currently polling on ``host`` — the
+        telemetry poller's progress-scrape targets."""
+        with self._lock:
+            return list(self._active_jobs.get(host, ()))
 
     # -- queue mechanics (all under self._lock) ------------------------------
 
@@ -300,6 +339,7 @@ class FleetCoordinator:
     def run(self, specs) -> dict[int, list[dict]]:
         ctx = obs.current()
         n = len(self.cfg.hosts)
+        self._run_started = time.monotonic()
         self._shards = [_ShardState(s) for s in specs]
         self.stats["shards"] = len(self._shards)
         ctx.count("fleet.shards", len(self._shards))
@@ -321,6 +361,20 @@ class FleetCoordinator:
             for j in range(self.cfg.inflight)
         ]
         deadline = time.monotonic() + self.cfg.run_timeout
+        # the telemetry plane is strictly optional: interval 0 means the
+        # module is never imported, no thread starts, no gauges exist
+        # (bench --smoke asserts exactly this), and the heartbeat's fleet
+        # fragment falls back to coordinator-local breaker state
+        poller = None
+        if self.cfg.telemetry_interval > 0:
+            from trivy_tpu.fleet.telemetry import start_poller
+
+            poller = start_poller(
+                self, ctx, interval=self.cfg.telemetry_interval
+            )
+        ctx.fleet_status = lambda: self._fleet_status(poller)
+        if poller is not None:
+            ctx.fleet_live = poller.live_fragment
         for w in workers:
             w.start()
         try:
@@ -338,6 +392,8 @@ class FleetCoordinator:
                 self._cond.notify_all()
             for w in workers:
                 w.join(timeout=30.0)
+            if poller is not None:
+                poller.stop()
         dead = [s for s in self._shards if s.state == "dead"]
         if dead:
             self._fallback(dead, ctx)
@@ -346,6 +402,11 @@ class FleetCoordinator:
         for key in ("steals", "speculative", "redispatches"):
             if self.stats[key]:
                 ctx.count(f"fleet.{key}", self.stats[key])
+        # the verdict is computed whether or not tracing is on (bench
+        # reads it for fleet_idle_share); the profile copy feeds report()
+        self.verdict = self._efficiency_verdict()
+        if ctx.enabled:
+            ctx.profile().note_fleet(self.verdict)
         out = {}
         for s in self._shards:
             if s.blobs is None:
@@ -358,6 +419,74 @@ class FleetCoordinator:
             self.stats["speculative"], self.stats["redispatches"],
             self.stats["local_fallback"],
         )
+        return out
+
+    def _fleet_status(self, poller) -> dict:
+        """Heartbeat-sized fleet snapshot (shards done/total + replica
+        health). With the telemetry poller off, replica health degrades
+        to the coordinator's own breaker view and fleet MB/s is unknown."""
+        with self._lock:
+            done = sum(1 for s in self._shards if s.done)
+            total = len(self._shards)
+        if poller is not None:
+            st = poller.status()
+        else:
+            n = len(self.cfg.hosts)
+            open_ = sum(
+                1 for j in range(n) if self.breaker.is_open(j)
+            )
+            st = {
+                "replicas": n,
+                "healthy": n - open_,
+                "breaker_open": open_,
+                "fleet_mbs": None,
+            }
+        st["shards_done"] = done
+        st["shards_total"] = total
+        return st
+
+    def _efficiency_verdict(self) -> dict[str, dict]:
+        """Per-replica efficiency buckets summing to exactly 100%:
+
+        - ``busy``: attempt wall time (wins, losses, cancelled twins — the
+          replica burned it either way) over worker capacity
+          (run wall x inflight);
+        - ``stalled_on_coordinator``: the tail between a replica's last
+          completion and fan-out end — it sat drained while the
+          coordinator had no work left to give it;
+        - ``dead``: 100 for a replica that completed nothing and ended
+          behind an open breaker;
+        - ``idle``: the remainder (queue gaps, poll latency).
+        """
+        run_wall = max(1e-9, time.monotonic() - self._run_started)
+        capacity = run_wall * max(1, self.cfg.inflight)
+        with self._lock:
+            busy = dict(self._host_busy)
+            last_done = dict(self._host_last_done)
+            shard_counts = dict(self.stats["replica_shards"])
+        out = {}
+        for j, host in enumerate(self.cfg.hosts):
+            row = {"shards": int(shard_counts.get(host, 0)),
+                   "busy_s": round(busy.get(host, 0.0), 3)}
+            if not shard_counts.get(host) and self.breaker.is_open(j):
+                row.update(busy=0.0, idle=0.0,
+                           stalled_on_coordinator=0.0, dead=100.0)
+                out[host] = row
+                continue
+            busy_pct = 100.0 * min(1.0, busy.get(host, 0.0) / capacity)
+            ld = last_done.get(host)
+            tail_s = max(0.0, run_wall - (ld - self._run_started)) \
+                if ld is not None else 0.0
+            stalled_pct = 100.0 * min(1.0, tail_s / run_wall)
+            busy_pct = min(busy_pct, 100.0 - stalled_pct)
+            buckets = {
+                "busy": busy_pct,
+                "idle": max(0.0, 100.0 - busy_pct - stalled_pct),
+                "stalled_on_coordinator": stalled_pct,
+                "dead": 0.0,
+            }
+            row.update(_normalize_100(buckets))
+            out[host] = row
         return out
 
     def _worker(self, i: int, ctx) -> None:
@@ -417,6 +546,7 @@ class FleetCoordinator:
                 resp = self._dispatch(i, shard)
             if resp is None:  # lost the speculation race mid-poll
                 with self._cond:
+                    self._host_busy[host] += time.monotonic() - t0
                     shard.running.discard(i)
                     self.stats["cancelled"] += 1
                     ctx.count("fleet.cancelled")
@@ -439,6 +569,9 @@ class FleetCoordinator:
                 self.breaker.is_open(j) for j in range(len(self.cfg.hosts))
             )
             with self._cond:
+                # a failed attempt still burned this replica's time — it
+                # counts toward the verdict's busy bucket
+                self._host_busy[host] += time.monotonic() - t0
                 shard.running.discard(i)
                 shard.failed_on.add(i)
                 if not shard.done and not shard.running:
@@ -461,7 +594,9 @@ class FleetCoordinator:
                 self._cond.notify_all()
             return
         self.breaker.record_success(i)
+        wall = time.monotonic() - t0
         with self._cond:
+            self._host_busy[host] += wall
             shard.running.discard(i)
             if shard.done:
                 # a twin attempt already won; this result is the loser
@@ -472,9 +607,15 @@ class FleetCoordinator:
             shard.done = True
             shard.state = "done"
             shard.blobs = list(blobs)
-            self._durations.append(time.monotonic() - t0)
+            self._durations.append(wall)
             self.stats["replica_shards"][host] += 1
+            self._host_last_done[host] = time.monotonic()
             self._cond.notify_all()
+        if ctx.enabled:
+            ctx.profile().note_shard(
+                host, shard.spec.nbytes, wall, stolen=shard.stolen,
+                speculated=shard.speculated, attempts=shard.attempts,
+            )
         self._fold_result(shard, resp, ctx)
 
     def _fold_result(self, shard: _ShardState, resp: dict, ctx) -> None:
@@ -555,6 +696,20 @@ class FleetCoordinator:
         from trivy_tpu.rpc.client import RPCError
 
         driver = self.drivers[i]
+        host = self.cfg.hosts[i]
+        # the telemetry poller scrapes live progress for whatever is in
+        # the active set; registration is best-effort bookkeeping only
+        with self._lock:
+            self._active_jobs[host].add(job_id)
+        try:
+            return self._poll_result_inner(
+                i, shard, job_id, ctx, driver, RPCError
+            )
+        finally:
+            with self._lock:
+                self._active_jobs[host].discard(job_id)
+
+    def _poll_result_inner(self, i, shard, job_id, ctx, driver, RPCError):
         deadline = time.monotonic() + self.cfg.job_timeout
         misses = 0
         polls = 0
@@ -629,6 +784,7 @@ class FleetCoordinator:
                 name=f"fleet-local:{shard.spec.label()}",
                 enabled=ctx.enabled, trace_id=ctx.trace_id,
             )
+            t0 = time.monotonic()
             with obs.activate(child):
                 with child.span("fleet.local_shard"):
                     try:
@@ -651,4 +807,12 @@ class FleetCoordinator:
             shard.blobs = list(blobs)
             self.stats["local_fallback"] += 1
             ctx.count("fleet.local_fallback")
+            if ctx.enabled:
+                # the degraded path is a pseudo-replica in the cost
+                # attribution — stragglers that died everywhere show up
+                # as "local" rows, not as missing bytes
+                ctx.profile().note_shard(
+                    "local", shard.spec.nbytes, time.monotonic() - t0,
+                    attempts=shard.attempts,
+                )
             self._fold_result(shard, resp, ctx)

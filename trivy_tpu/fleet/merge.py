@@ -49,6 +49,14 @@ class FleetArtifact:
     def stats(self) -> dict:
         return dict(self.coordinator.stats) if self.coordinator else {}
 
+    def telemetry(self) -> dict:
+        """The fan-out's fleet telemetry doc (per-replica headroom/health
+        series attached to the scan context at poller stop), or {} when
+        the poller was off / no fan-out has run. Bench and report callers
+        read this instead of reaching into the coordinator."""
+        ctx = obs.current()
+        return dict(getattr(ctx, "fleet", None) or {})
+
     def inspect(self) -> ArtifactReference:
         from trivy_tpu.fleet import plan as fleet_plan
 

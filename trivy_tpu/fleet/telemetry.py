@@ -1,0 +1,360 @@
+"""Fleet telemetry plane: the coordinator's sensor half of ROADMAP 4(c).
+
+A :class:`ReplicaPoller` thread scrapes each fleet replica's existing
+``GET /metrics`` exposition (parsed back into typed samples by
+:func:`trivy_tpu.obs.metrics.parse_text`, the renderer's inverse) and the
+live progress of that replica's in-flight shard jobs on a cadence
+(``--fleet-telemetry-interval`` / ``TRIVY_TPU_FLEET_TELEMETRY_INTERVAL``;
+0 = off with zero threads, buffers, or gauges — this module is not even
+imported then, ``bench --smoke`` asserts it). Scrapes fold into bounded
+per-replica :class:`ReplicaHealth` timeseries — link MB/s, device busy
+ratio, arena free slabs, admission queue depth, breaker state — each with
+a :meth:`ReplicaHealth.headroom` score in [0, 1]: the exact input surface
+item 4(c)'s headroom-weighted dispatch will consume.
+
+Aggregated surfaces fed from here:
+
+- ``trivy_tpu_fleet_*{replica="host:port"}`` gauges re-exported on the
+  coordinator's own process registry (so a coordinator that is itself a
+  server re-exposes fleet health on its ``/metrics``); label rows retire
+  at poller stop, and concurrent fleets with distinct replica sets keep
+  disjoint label sets by construction.
+- per-replica counter tracks in the one merged Perfetto timeline and a
+  ``fleet`` block in ``--metrics-out`` / ``--timeseries-out`` (via
+  ``ctx.fleet``, attached at poller stop).
+- the fleet ``--live`` line fragment and the heartbeat fleet fragment.
+
+Lifecycle mirrors :class:`trivy_tpu.obs.timeseries.Sampler`: baseline
+tick before the thread starts, daemon thread parked on an Event between
+ticks, idempotent :meth:`ReplicaPoller.stop` from the coordinator's
+``finally`` with a final tick and per-replica gauge retirement. A dead
+replica's scrape failure is recorded (headroom 0, breaker state), never
+raised — a dying replica must not kill the telemetry tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from trivy_tpu import log
+from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs.timeseries import Timeseries
+from trivy_tpu.tuning import DEFAULT_FLEET_TELEMETRY_INTERVAL
+
+logger = log.logger("fleet:telemetry")
+
+# replica-side gauge families the scrape folds (name -> series name)
+_SCRAPE_FOLD = {
+    "trivy_tpu_link_mbs": "link_mbs",
+    "trivy_tpu_arena_free_slabs": "arena_free_slabs",
+}
+
+# coordinator-side re-export gauges, all labeled {replica="host:port"}
+_FLEET_GAUGE_SPECS = (
+    ("trivy_tpu_fleet_link_mbs",
+     "Per-replica host->device link bandwidth (MB/s), scraped by the "
+     "fleet coordinator"),
+    ("trivy_tpu_fleet_device_busy_ratio",
+     "Per-replica max device busy fraction, scraped by the fleet "
+     "coordinator"),
+    ("trivy_tpu_fleet_arena_free_slabs",
+     "Per-replica free feed-arena slabs, scraped by the fleet "
+     "coordinator"),
+    ("trivy_tpu_fleet_queue_depth",
+     "Per-replica admission queue depth (all tenants), scraped by the "
+     "fleet coordinator"),
+    ("trivy_tpu_fleet_breaker_open",
+     "1 when the coordinator's circuit breaker for this replica is open "
+     "or its last scrape failed"),
+    ("trivy_tpu_fleet_headroom",
+     "Per-replica dispatch headroom score in [0,1] (0 = unreachable or "
+     "breaker-open)"),
+)
+
+
+def _fleet_gauge(name: str, help: str) -> obs_metrics.Gauge:
+    return obs_metrics.REGISTRY.gauge(name, help, labelnames=("replica",))
+
+
+class ReplicaHealth:
+    """One replica's bounded health series plus scrape bookkeeping.
+
+    Series timestamps are seconds relative to the owning scan context's
+    creation (same clock as local spans and sampler series), so the
+    per-replica counter tracks join the merged timeline with no base
+    shift. Scalar snapshot fields (``breaker_open``, ``reachable``,
+    scrape counts) are written by the poller thread only; readers get
+    last-tick values, which is all a headroom consumer needs.
+    """
+
+    def __init__(self, host: str):
+        self.host = host
+        self.series = Timeseries()
+        self.scrapes = 0
+        self.scrape_failures = 0
+        self.reachable = False  # last scrape succeeded
+        self.breaker_open = False  # coordinator breaker OR unreachable
+        self.last: dict[str, float] = {}  # latest folded values
+
+    def note_scrape(self, t: float, parsed: dict) -> None:
+        """Fold one parsed ``/metrics`` body at timestamp ``t``."""
+        self.scrapes += 1
+        self.reachable = True
+        vals: dict[str, float] = {}
+        for metric, series in _SCRAPE_FOLD.items():
+            fam = parsed.get(metric)
+            v = fam.first() if fam is not None else None
+            if v is not None:
+                vals[series] = v
+        busy = parsed.get("trivy_tpu_device_busy_ratio")
+        if busy is not None and busy.samples:
+            vals["device_busy_ratio"] = busy.max()
+        queue = parsed.get("trivy_tpu_admission_queue_depth")
+        # a replica without admission control exports no queue gauge:
+        # treat as depth 0 (nothing queued), not unknown
+        vals["queue_depth"] = queue.sum() if queue is not None else 0.0
+        breaker = parsed.get("trivy_tpu_device_breaker_open")
+        if breaker is not None and breaker.samples:
+            vals["device_breaker_open"] = breaker.max()
+        inflight = parsed.get("trivy_tpu_requests_in_flight")
+        if inflight is not None and inflight.first() is not None:
+            vals["requests_in_flight"] = inflight.first()
+        for name, v in vals.items():
+            self.series.record(name, t, v)
+        self.last.update(vals)
+
+    def note_failure(self, t: float) -> None:
+        self.scrapes += 1
+        self.scrape_failures += 1
+        self.reachable = False
+        self.series.record("headroom", t, 0.0)
+
+    def note_progress(self, t: float, ratio: float, jobs: int) -> None:
+        self.series.record("progress_ratio", t, ratio)
+        self.series.record("jobs_active", t, float(jobs))
+        self.last["progress_ratio"] = ratio
+
+    def headroom(self) -> float:
+        """Dispatch headroom in [0, 1] — the 4(c) placement input.
+
+        0.0 when the replica is unreachable or its breaker is open
+        (dispatching there is wasted work regardless of its last-known
+        load); otherwise ``(1 - busy) / (1 + queue_depth)``, halved when
+        the feed arena is starved (0 free slabs: accepted work would
+        stall on allocation, not run).
+        """
+        if not self.reachable or self.breaker_open:
+            return 0.0
+        busy = min(1.0, max(0.0, self.last.get("device_busy_ratio", 0.0)))
+        queue = max(0.0, self.last.get("queue_depth", 0.0))
+        score = (1.0 - busy) / (1.0 + queue)
+        arena = self.last.get("arena_free_slabs")
+        if arena is not None and arena <= 0:
+            score *= 0.5
+        return round(min(1.0, max(0.0, score)), 4)
+
+    def to_doc(self) -> dict:
+        """Wire form for the ``fleet`` block: summary always, full series
+        points for ``--timeseries-out`` via ``series``."""
+        return {
+            "headroom": self.headroom(),
+            "breaker_open": bool(self.breaker_open),
+            "reachable": bool(self.reachable),
+            "scrapes": self.scrapes,
+            "scrape_failures": self.scrape_failures,
+            "summary": self.series.summary(),
+            "series": self.series.to_doc(),
+        }
+
+
+class ReplicaPoller:
+    """The coordinator's fleet telemetry thread (see module docstring)."""
+
+    def __init__(self, coordinator, ctx, interval: float,
+                 clock=time.perf_counter):
+        self.coord = coordinator
+        self.ctx = ctx
+        self.interval = interval
+        self.clock = clock
+        self.hosts = list(coordinator.cfg.hosts)
+        self.health = {h: ReplicaHealth(h) for h in self.hosts}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._gauge_rows: set[str] = set()  # replica labels we ever set
+
+    # -- one tick ------------------------------------------------------------
+
+    def scrape_once(self) -> None:
+        from trivy_tpu.rpc.client import RPCError, get_metrics_text
+
+        cfg = self.coord.cfg
+        # a dead replica must not stall the tick for the default RPC
+        # timeout: the scrape deadline tracks the poll cadence (floor
+        # 0.5 s so a loaded replica still answers), so one vanished host
+        # costs at most ~one interval, not 5 s of serial head-of-line
+        deadline = min(5.0, max(0.5, self.interval))
+        for i, host in enumerate(self.hosts):
+            t = self.clock() - self.ctx.created
+            rh = self.health[host]
+            coord_open = bool(self.coord.breaker.is_open(i))
+            try:
+                text = get_metrics_text(host, token=cfg.token,
+                                        timeout=deadline)
+                parsed = obs_metrics.parse_text(text)
+            except (RPCError, obs_metrics.ParseError, OSError) as e:
+                # a dead replica is telemetry, not an error: headroom
+                # drops to 0 and the breaker row flips — the tick lives
+                logger.debug("telemetry scrape of %s failed: %s", host, e)
+                rh.breaker_open = True
+                rh.note_failure(t)
+                self._export(host, rh)
+                continue
+            rh.breaker_open = coord_open
+            rh.note_scrape(t, parsed)
+            self._poll_progress(i, host, rh, t)
+            rh.series.record("headroom", t, rh.headroom())
+            self._export(host, rh)
+
+    def _poll_progress(self, i: int, host: str, rh: ReplicaHealth,
+                       t: float) -> None:
+        """Fold the replica's active shard jobs' live progress (advisory:
+        any failure is skipped, the shard result path owns correctness)."""
+        jobs = self.coord.active_jobs(host)
+        if not jobs:
+            return
+        ratios = []
+        driver = self.coord.drivers[i]
+        for job_id in jobs:
+            try:
+                snap = driver.progress(job_id)
+            except Exception:
+                continue
+            total = float(snap.get("BytesWalked") or 0)
+            if total > 0:
+                ratios.append(
+                    min(1.0, float(snap.get("BytesScanned") or 0) / total)
+                )
+            elif snap.get("Ratio") is not None:
+                ratios.append(min(1.0, float(snap["Ratio"])))
+        if ratios:
+            rh.note_progress(t, sum(ratios) / len(ratios), len(jobs))
+
+    def _export(self, host: str, rh: ReplicaHealth) -> None:
+        """Mirror a replica's latest health to the coordinator-side
+        ``trivy_tpu_fleet_*{replica=}`` gauges."""
+        self._gauge_rows.add(host)
+        vals = {
+            "trivy_tpu_fleet_link_mbs": rh.last.get("link_mbs"),
+            "trivy_tpu_fleet_device_busy_ratio":
+                rh.last.get("device_busy_ratio"),
+            "trivy_tpu_fleet_arena_free_slabs":
+                rh.last.get("arena_free_slabs"),
+            "trivy_tpu_fleet_queue_depth": rh.last.get("queue_depth"),
+            "trivy_tpu_fleet_breaker_open": 1.0 if rh.breaker_open else 0.0,
+            "trivy_tpu_fleet_headroom": rh.headroom(),
+        }
+        for name, help in _FLEET_GAUGE_SPECS:
+            v = vals[name]
+            if v is not None:
+                _fleet_gauge(name, help).set(round(v, 4), replica=host)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ReplicaPoller":
+        # baseline tick so even a sub-interval fan-out gets one sample
+        # per replica (and the fleet gauges exist from the first moment
+        # a scrape of the coordinator could observe the fleet)
+        try:
+            self.scrape_once()
+        except Exception as e:
+            logger.debug("baseline fleet telemetry tick failed: %s", e)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"fleet-telemetry-{self.ctx.trace_id[:8]}",
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from trivy_tpu import obs
+
+        with obs.activate(self.ctx):
+            while not self._stop.wait(self.interval):
+                try:
+                    self.scrape_once()
+                except Exception as e:  # no tick may kill the poller
+                    logger.debug("fleet telemetry tick failed: %s", e)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Idempotent: stop the thread, take one final tick so every
+        series carries the end state, retire this fleet's gauge label
+        rows (concurrent fleets' rows — different replica addresses —
+        survive untouched), and attach the fleet doc to the context."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+            try:
+                self.scrape_once()
+            except Exception as e:
+                logger.debug("final fleet telemetry tick failed: %s", e)
+        for name, help in _FLEET_GAUGE_SPECS:
+            g = _fleet_gauge(name, help)
+            for host in self._gauge_rows:
+                g.remove(replica=host)
+        self._gauge_rows.clear()
+        self.ctx.fleet = self.fleet_doc()
+
+    # -- aggregated surfaces -------------------------------------------------
+
+    def fleet_doc(self) -> dict:
+        return {
+            "interval_s": self.interval,
+            "replicas": {h: self.health[h].to_doc() for h in self.hosts},
+        }
+
+    def live_fragment(self) -> str:
+        """Compact per-replica status for the ``--live`` line, e.g.
+        ``fleet[r0 83% 412MB/s q0 | r1 OPEN]``."""
+        parts = []
+        for k, host in enumerate(self.hosts):
+            rh = self.health[host]
+            if rh.breaker_open or not rh.reachable:
+                parts.append(f"r{k} OPEN")
+                continue
+            busy = rh.last.get("device_busy_ratio", 0.0) * 100.0
+            mbs = rh.last.get("link_mbs", 0.0)
+            q = int(rh.last.get("queue_depth", 0))
+            parts.append(f"r{k} {busy:.0f}% {mbs:.0f}MB/s q{q}")
+        return "fleet[" + " | ".join(parts) + "]"
+
+    def status(self) -> dict:
+        """Heartbeat-sized aggregate: replicas healthy / breaker-open and
+        the summed fleet link MB/s (latest tick)."""
+        healthy = open_ = 0
+        mbs = 0.0
+        for rh in self.health.values():
+            if rh.breaker_open or not rh.reachable:
+                open_ += 1
+            else:
+                healthy += 1
+                mbs += rh.last.get("link_mbs", 0.0)
+        return {
+            "replicas": len(self.hosts),
+            "healthy": healthy,
+            "breaker_open": open_,
+            "fleet_mbs": round(mbs, 1),
+        }
+
+
+def start_poller(coordinator, ctx, interval: float | None = None):
+    """Spawn the fleet poller unless telemetry is off. ``interval`` None
+    resolves the tuning default; <= 0 disables everything — no thread, no
+    ReplicaHealth buffers, no fleet gauges (callers must gate the import
+    of this module on the interval too; see ``FleetCoordinator.run``)."""
+    if interval is None:
+        interval = DEFAULT_FLEET_TELEMETRY_INTERVAL
+    if interval <= 0:
+        return None
+    return ReplicaPoller(coordinator, ctx, interval=interval).start()

@@ -236,6 +236,13 @@ class TraceContext:
         # on close when the codec is active; None on uncompressed scans so
         # exports show no empty block
         self.wire: dict | None = None
+        # fleet telemetry (trivy_tpu/fleet/telemetry.py): the per-replica
+        # health doc attached at poller stop, plus the coordinator's
+        # heartbeat-status and --live-fragment callables — all None on
+        # non-fleet scans so exports show no empty block
+        self.fleet: dict | None = None
+        self.fleet_status = None
+        self.fleet_live = None
         # always-on scan progress (bytes/files walked vs scanned), created
         # lazily by progress() — like health, NOT gated on `enabled`
         self._progress = None
@@ -532,7 +539,8 @@ class TraceContext:
             for name, value in sorted((doc.get("counters") or {}).items()):
                 counters.append((f"server:{name}", value))
         prof_doc = self.merged_profile_dict()
-        has_profile = bool(prof_doc.get("rules") or prof_doc.get("buckets"))
+        has_profile = bool(prof_doc.get("rules") or prof_doc.get("buckets")
+                           or prof_doc.get("fleet"))
         if not stats and not counters and not samples and not has_profile:
             return
         rows = sorted(stats.items(), key=lambda kv: -kv[1]["total"])
@@ -575,6 +583,13 @@ class TraceContext:
                 + "-" * 33 + "\n"
             )
             for line in prof_lines:
+                out.write(line + "\n")
+        fleet_lines = _profile.fleet_table_lines(prof_doc)
+        if fleet_lines:
+            # fleet efficiency verdict: per-replica busy/idle/stalled/dead
+            # buckets (sum 100%) — the distributed twin of the stall verdict
+            out.write("-- fleet efficiency " + "-" * 60 + "\n")
+            for line in fleet_lines:
                 out.write(line + "\n")
         if self.dropped_events:
             out.write(
@@ -812,6 +827,26 @@ class heartbeat:
             if ctl is not None:
                 frag += f" ({len(ctl.decisions)} decisions)"
             parts.append(frag)
+        # fleet fragment: the coordinator registers a status callable for
+        # the duration of a fan-out (works with the telemetry poller off
+        # too — replica health then degrades to breaker state, MB/s is
+        # unknown), so a fleet scan's beats carry shard and replica counts
+        status = getattr(ctx, "fleet_status", None) if ctx is not None \
+            else None
+        if status is not None:
+            try:
+                st = status()
+                frag = (
+                    f"fleet {st['shards_done']}/{st['shards_total']} "
+                    f"shards, {st['healthy']}/{st['replicas']} healthy"
+                )
+                if st.get("breaker_open"):
+                    frag += f", {st['breaker_open']} open"
+                if st.get("fleet_mbs") is not None:
+                    frag += f", {st['fleet_mbs']:.1f} MB/s"
+                parts.append(frag)
+            except Exception:
+                pass
         return " [" + ", ".join(parts) + "]"
 
     def _loop(self) -> None:

@@ -288,6 +288,27 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
             emit_counters(pid, doc["timeseries"], base_us)
         if doc.get("tuning"):
             emit_tuning(pid, doc["tuning"], base_us)
+    fleet = getattr(ctx, "fleet", None)
+    if fleet:
+        # fleet replicas join the one merged timeline as their own
+        # processes, after the remote shard-trace pids: the poller's
+        # per-replica health series (scraped on the coordinator's own
+        # clock, so base shift 0) render as counter tracks
+        for i, (host, rep) in enumerate(
+            sorted((fleet.get("replicas") or {}).items())
+        ):
+            pid = 2 + len(remote_docs) + i
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"trivy-tpu fleet replica {host}"},
+                }
+            )
+            if rep.get("series"):
+                emit_counters(pid, rep["series"])
     return events
 
 
@@ -355,6 +376,19 @@ def metrics_dict(ctx: TraceContext) -> dict:
         # the gate/fallback byte counters behind it — only present when the
         # codec actually ran, so compression-off exports stay byte-identical
         doc["wire"] = wire
+    fleet = getattr(ctx, "fleet", None)
+    if fleet:
+        # fleet telemetry plane: per-replica headroom/health summaries
+        # (full series points ride --timeseries-out, same split as the
+        # local sampler series) — only present on fleet scans with the
+        # poller on, so single-host exports stay byte-identical
+        doc["fleet"] = {
+            "interval_s": fleet.get("interval_s"),
+            "replicas": {
+                host: {k: v for k, v in rep.items() if k != "series"}
+                for host, rep in (fleet.get("replicas") or {}).items()
+            },
+        }
     if remote_docs:
         doc["remote"] = [
             {
@@ -429,6 +463,10 @@ def timeseries_dict(ctx: TraceContext) -> dict:
     ]
     if remote:
         doc["remote"] = remote
+    fleet = getattr(ctx, "fleet", None)
+    if fleet:
+        # the full-points twin of metrics_dict's fleet summary block
+        doc["fleet"] = fleet
     return doc
 
 

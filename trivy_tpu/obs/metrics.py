@@ -6,10 +6,17 @@ client library — the container pins its dependency set — and the subset
 here (no summaries, no exemplars, no timestamps) is everything the server
 surface needs: scan counts, per-stage latency histograms, cache hit/miss,
 dedup bytes, and an in-flight gauge.
+
+:func:`parse_text` is the renderer's inverse: the fleet telemetry poller
+scrapes each replica's ``GET /metrics`` and parses the exposition text back
+into typed samples. Parser and renderer are property-tested as a round
+trip, which pins the label-value escaping rules (``\\`` first, then ``"``
+and newline) on both sides.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -29,6 +36,14 @@ SCAN_BUCKETS = (
 
 def _escape(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(value: str) -> str:
+    # HELP lines escape only backslash and newline (the exposition format's
+    # rule — quotes stay literal there, unlike label values); an unescaped
+    # newline in help text used to split the line and corrupt every metric
+    # rendered after it
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_labels(labelnames: tuple[str, ...], labelvalues: tuple[str, ...]) -> str:
@@ -218,7 +233,7 @@ class Registry:
             metrics = sorted(self._metrics.items())
         for name, m in metrics:
             if m.help:
-                out.append(f"# HELP {name} {m.help}")
+                out.append(f"# HELP {name} {_escape_help(m.help)}")
             out.append(f"# TYPE {name} {m.kind}")
             out.extend(m.render())
         return "\n".join(out) + "\n"
@@ -226,3 +241,215 @@ class Registry:
 
 # process-global registry for callers without a server-scoped one
 REGISTRY = Registry()
+
+
+# -- exposition-text parser (the renderer's inverse) --------------------------
+
+_METRIC_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+
+
+def _unescape(value: str) -> str:
+    """Inverse of :func:`_escape` (label values: ``\\\\``, ``\\"``,
+    ``\\n``); an unknown escape keeps the backslash literally, matching
+    the Prometheus reference parser's tolerance."""
+    if "\\" not in value:
+        return value
+    out = []
+    i, n = 0, len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == '"':
+                out.append('"')
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _unescape_help(value: str) -> str:
+    """Inverse of :func:`_escape_help` (``\\\\`` and ``\\n`` only)."""
+    if "\\" not in value:
+        return value
+    out = []
+    i, n = 0, len(value)
+    while i < n:
+        c = value[i]
+        if c == "\\" and i + 1 < n:
+            nxt = value[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+class ParseError(ValueError):
+    """A line the exposition grammar cannot account for. Loud by design:
+    a half-parsed scrape silently missing gauges would feed the fleet
+    headroom scorer fabricated zeros."""
+
+
+class ParsedMetric:
+    """One metric family from a parsed exposition: declared ``kind`` /
+    ``help`` (from TYPE/HELP lines; ``untyped``/empty when undeclared) and
+    every sample line as ``(labels dict, value)`` pairs under the sample's
+    full name (histograms surface as their ``_bucket``/``_sum``/``_count``
+    series)."""
+
+    __slots__ = ("name", "kind", "help", "samples")
+
+    def __init__(self, name: str, kind: str = "untyped", help: str = ""):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.samples: list[tuple[dict, float]] = []
+
+    def value(self, **labels) -> float | None:
+        """First sample whose labels equal ``labels`` exactly, or None."""
+        want = {k: str(v) for k, v in labels.items()}
+        for lbl, v in self.samples:
+            if lbl == want:
+                return v
+        return None
+
+    def first(self) -> float | None:
+        return self.samples[0][1] if self.samples else None
+
+    def max(self) -> float | None:
+        return max((v for _, v in self.samples), default=None)
+
+    def sum(self) -> float:
+        return sum(v for _, v in self.samples)
+
+
+def _parse_labels(text: str, line: str) -> tuple[dict, str]:
+    """Parse ``{name="value",...}`` off the front of ``text`` (label
+    values honor the escape rules); returns (labels, remainder)."""
+    labels: dict[str, str] = {}
+    i = 1  # past '{'
+    n = len(text)
+    while True:
+        if i >= n:
+            raise ParseError(f"unterminated label set: {line!r}")
+        if text[i] == "}":
+            i += 1
+            break
+        m = _LABEL_NAME_RE.match(text, i)
+        if m is None:
+            raise ParseError(f"bad label name at col {i}: {line!r}")
+        lname = m.group(0)
+        i = m.end()
+        if not text.startswith('="', i):
+            raise ParseError(f"expected '=\"' after label {lname}: {line!r}")
+        i += 2
+        buf = []
+        while True:
+            if i >= n:
+                raise ParseError(f"unterminated label value: {line!r}")
+            c = text[i]
+            if c == "\\" and i + 1 < n:
+                buf.append(text[i:i + 2])
+                i += 2
+                continue
+            if c == '"':
+                i += 1
+                break
+            buf.append(c)
+            i += 1
+        labels[lname] = _unescape("".join(buf))
+        if i < n and text[i] == ",":
+            i += 1
+    return labels, text[i:]
+
+
+def parse_text(text: str) -> dict[str, ParsedMetric]:
+    """Parse exposition text back into metric families — the inverse of
+    :meth:`Registry.render`, used by the fleet poller on scraped replica
+    ``/metrics`` bodies. The result is keyed by sample name; TYPE/HELP
+    declarations attach kind/help to their family (and histogram
+    ``_bucket``/``_sum``/``_count`` samples inherit the base family's
+    kind). A malformed line raises :class:`ParseError`. Two registries
+    concatenated into one scrape (the server renders its own plus the
+    process-global one) parse fine: duplicate TYPE/HELP redeclarations are
+    tolerated, samples accumulate."""
+    declared: dict[str, tuple[str, str]] = {}  # name -> (kind, help)
+    out: dict[str, ParsedMetric] = {}
+
+    def family(name: str) -> ParsedMetric:
+        fam = out.get(name)
+        if fam is None:
+            # histogram series inherit the base declaration
+            kind, hlp = declared.get(name, ("", ""))
+            if not kind:
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if name.endswith(suffix):
+                        base = name[: -len(suffix)]
+                        bkind, bhelp = declared.get(base, ("", ""))
+                        if bkind == "histogram":
+                            kind, hlp = bkind, bhelp
+                        break
+            fam = out[name] = ParsedMetric(name, kind or "untyped", hlp)
+        return fam
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                kind = parts[3] if len(parts) > 3 else "untyped"
+                prev = declared.get(parts[2], ("", ""))
+                declared[parts[2]] = (kind, prev[1])
+                if parts[2] in out:
+                    out[parts[2]].kind = kind
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                hlp = _unescape_help(parts[3]) if len(parts) > 3 else ""
+                prev = declared.get(parts[2], ("", ""))
+                declared[parts[2]] = (prev[0], hlp)
+                if parts[2] in out:
+                    out[parts[2]].help = hlp
+            # other comments are ignored per the format
+            continue
+        m = _METRIC_NAME_RE.match(line)
+        if m is None:
+            raise ParseError(f"bad sample line: {line!r}")
+        name = m.group(0)
+        rest = line[m.end():]
+        labels: dict[str, str] = {}
+        if rest.startswith("{"):
+            labels, rest = _parse_labels(rest, line)
+        value_str = rest.split()[0] if rest.split() else ""
+        try:
+            value = float(value_str)
+        except ValueError:
+            raise ParseError(
+                f"bad sample value {value_str!r}: {line!r}"
+            ) from None
+        family(name).samples.append((labels, value))
+    for name, (kind, hlp) in declared.items():
+        # a declared family with no samples still parses (labeled metric
+        # with zero label sets renders TYPE-only)
+        if name not in out:
+            out[name] = ParsedMetric(name, kind or "untyped", hlp)
+        elif hlp and not out[name].help:
+            out[name].help = hlp
+    return out

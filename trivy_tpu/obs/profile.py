@@ -70,10 +70,16 @@ class ScanProfile:
         # keyword-prefilter pass totals: [rows inspected, rows whose batch
         # skipped the anchored/NFA dispatch, rows with >=1 candidate rule]
         self._pre = [0, 0, 0]
+        # fleet cost attribution: per-shard rows (replica, bytes, wall,
+        # steal/speculation provenance, attempts) + the per-replica
+        # efficiency verdict the coordinator computes at fan-out end
+        self._shards: list[dict] = []
+        self._fleet_replicas: dict[str, dict] = {}
 
     def __bool__(self) -> bool:
         with self._lock:
-            return bool(self._rules or self._buckets)
+            return bool(self._rules or self._buckets or self._shards
+                        or self._fleet_replicas)
 
     def _rule(self, rule_id: str) -> list:
         r = self._rules.get(rule_id)
@@ -129,6 +135,30 @@ class ScanProfile:
             b[1] += rows
             b[2] += wait_s
 
+    def note_shard(self, replica: str, nbytes: int, wall_s: float,
+                   stolen: bool = False, speculated: bool = False,
+                   attempts: int = 1) -> None:
+        """One completed fleet shard's cost row: which replica ran it,
+        how many planned bytes it covered, its winning-attempt wall time,
+        and how it got there (stolen from a peer's queue / a speculative
+        twin / after ``attempts - 1`` retries)."""
+        with self._lock:
+            self._shards.append({
+                "replica": replica,
+                "bytes": int(nbytes),
+                "wall_ms": round(wall_s * 1e3, 3),
+                "stolen": bool(stolen),
+                "speculated": bool(speculated),
+                "attempts": int(attempts),
+            })
+
+    def note_fleet(self, replicas: dict[str, dict]) -> None:
+        """Attach the coordinator's per-replica efficiency verdict:
+        ``{host: {"busy": %, "idle": %, "stalled_on_coordinator": %,
+        "dead": %, ...}}`` — the four buckets sum to 100 per replica."""
+        with self._lock:
+            self._fleet_replicas.update(replicas)
+
     def merge_dict(self, doc: dict) -> None:
         """Fold a serialized profile (:meth:`to_dict` output) into this one
         — used to merge a remote scan's profile into the client's."""
@@ -159,6 +189,11 @@ class ScanProfile:
                 b[0] += int(bf.get("dispatches", 0))
                 b[1] += int(bf.get("rows", 0))
                 b[2] += float(bf.get("device_wait_ms", 0.0)) / 1e3
+        fleet = doc.get("fleet") or {}
+        if fleet:
+            with self._lock:
+                self._shards.extend(fleet.get("shards") or [])
+                self._fleet_replicas.update(fleet.get("replicas") or {})
 
     # -- serialization ------------------------------------------------------
 
@@ -170,6 +205,9 @@ class ScanProfile:
             rules = {k: list(v) for k, v in self._rules.items()}
             buckets = {k: list(v) for k, v in self._buckets.items()}
             pre_rows, pre_skipped, pre_hit_rows = self._pre
+            shards = [dict(s) for s in self._shards]
+            fleet_replicas = {k: dict(v)
+                              for k, v in self._fleet_replicas.items()}
         items = sorted(rules.items(), key=lambda kv: (-kv[1][2], -kv[1][0], kv[0]))
         if top_k is not None:
             items = items[:top_k]
@@ -201,6 +239,11 @@ class ScanProfile:
                 for k, (d, rows, s) in sorted(buckets.items())
             },
         }
+        if shards or fleet_replicas:
+            doc["fleet"] = {
+                "shards": shards,
+                "replicas": fleet_replicas,
+            }
         if pre_rows:
             doc["prefilter"] = {
                 "rows": pre_rows,
@@ -221,6 +264,37 @@ def top_rules(doc: dict, k: int | None = None) -> list[tuple[str, dict]]:
         key=lambda kv: (-kv[1].get("confirm_ms", 0.0), -kv[1].get("gate_hits", 0), kv[0]),
     )
     return items[: TOP_K if k is None else k]
+
+
+def fleet_table_lines(doc: dict) -> list[str]:
+    """Formatted fleet efficiency verdict for the --trace report: one row
+    per replica with shard count, bytes, and the four 100%-sum buckets
+    (``busy`` scanning shards / ``idle`` waiting for work / ``stalled``
+    on the coordinator's tail / ``dead`` behind an open breaker)."""
+    fleet = doc.get("fleet") or {}
+    replicas = fleet.get("replicas") or {}
+    if not replicas:
+        return []
+    per_host: dict[str, list] = {}  # host -> [shards, bytes, wall_ms]
+    for row in fleet.get("shards") or []:
+        agg = per_host.setdefault(row.get("replica", "?"), [0, 0, 0.0])
+        agg[0] += 1
+        agg[1] += int(row.get("bytes", 0))
+        agg[2] += float(row.get("wall_ms", 0.0))
+    lines = [
+        f"{'replica':<28}{'shards':>7}{'MB':>9}{'busy%':>7}{'idle%':>7}"
+        f"{'stall%':>7}{'dead%':>6}"
+    ]
+    for host in sorted(replicas):
+        v = replicas[host]
+        shards, nbytes, _ = per_host.get(host, [0, 0, 0.0])
+        lines.append(
+            f"{host:<28}{shards:>7}{nbytes / 1e6:>9.1f}"
+            f"{v.get('busy', 0.0):>7.1f}{v.get('idle', 0.0):>7.1f}"
+            f"{v.get('stalled_on_coordinator', 0.0):>7.1f}"
+            f"{v.get('dead', 0.0):>6.1f}"
+        )
+    return lines
 
 
 def table_lines(doc: dict, k: int | None = None) -> list[str]:

@@ -574,6 +574,15 @@ class LiveProgress:
             free = ts.latest("secret.arena_free_slabs")
             if free is not None:
                 parts.append(f"arena free {free:.0f}")
+        # fleet column: the telemetry poller's per-replica fragment
+        # (busy % / MB/s / queue depth / breaker state) — set by the
+        # coordinator only while a fleet fan-out with polling is live
+        fleet_live = getattr(self.ctx, "fleet_live", None)
+        if fleet_live is not None:
+            try:
+                parts.append(fleet_live())
+            except Exception:
+                pass
         # online-tuning column: current knob set + decision count, so an
         # operator watching --live sees every mid-scan adaptation land
         ctl = getattr(self.ctx, "tuning_controller", None)

@@ -386,6 +386,32 @@ def get_progress(server: str, trace_id: str, token: str = "",
     return doc
 
 
+def get_metrics_text(server: str, token: str = "",
+                     token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                     timeout: float = POLL_TIMEOUT) -> str:
+    """One scrape of the server's ``GET /metrics`` exposition text. Unlike
+    the JSON polls this returns the raw Prometheus text body (the caller
+    parses it with :func:`trivy_tpu.obs.metrics.parse_text`) — same
+    fail-fast discipline as :func:`get_progress`: no retry ladder, the
+    telemetry tick loop IS the retry."""
+    base = server if "://" in server else f"http://{server}"
+    url = base.rstrip("/") + "/metrics"
+    headers = {}
+    if token:
+        headers[token_header] = token
+    try:
+        status, rheaders, data = _POOL.request(
+            url, "GET", None, headers, timeout
+        )
+    except (OSError, http.client.HTTPException) as e:
+        raise RPCError(f"metrics scrape {server}: {e}") from e
+    if status >= 300:
+        raise RPCError(f"metrics scrape {server}: HTTP {status}")
+    return (_decode_body(rheaders, data) or b"").decode(
+        "utf-8", errors="replace"
+    )
+
+
 def get_result(server: str, job_id: str, token: str = "",
                token_header: str = rpc.DEFAULT_TOKEN_HEADER,
                timeout: float = POLL_TIMEOUT) -> dict:

@@ -554,6 +554,17 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 self._reply(200, {"Version": __version__})
                 return
             if self.path == rpc.METRICS:
+                # monitoring must outlive admission: this route (like
+                # /healthz) deliberately skips the draining check and keeps
+                # answering 200 through a drain — the fleet telemetry
+                # poller keeps scoring a draining replica from live gauges
+                # instead of misreading a refused scrape as replica death.
+                # Drain state itself is a gauge so scrapers see it flip.
+                server.metrics.registry.gauge(
+                    "trivy_tpu_server_draining",
+                    "1 while this server drains (sheds new work, keeps "
+                    "answering monitoring probes)",
+                ).set(1.0 if server.draining else 0.0)
                 # server-scoped registry plus the process-global one, which
                 # carries the failure-domain gauges (device breaker state,
                 # cache degradation, degraded-scan count) — metric names

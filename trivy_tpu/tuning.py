@@ -61,6 +61,13 @@ ENV_TUNING_FILE = "TRIVY_TPU_TUNING_FILE"
 # sample of every gauge (--tuning-interval / TRIVY_TPU_TUNING_INTERVAL)
 DEFAULT_TUNING_INTERVAL = 0.5
 
+# fleet replica-poller cadence (--fleet-telemetry-interval /
+# TRIVY_TPU_FLEET_TELEMETRY_INTERVAL, 0 = off): one /metrics scrape per
+# replica per tick. Coarser than the in-process sampler's 250 ms — each
+# tick is N HTTP round trips, and replica gauges only refresh at the
+# replica's own sampler cadence anyway
+DEFAULT_FLEET_TELEMETRY_INTERVAL = 1.0
+
 # knobs TuningConfig owns; order is the canonical display/serialize order
 KNOBS = (
     "feed_streams", "inflight", "arena_slabs", "bucket_rungs", "parallel",
@@ -154,6 +161,9 @@ class TuningConfig:
     # (0 = codec default 0.875, the 7-bit-packing line)
     controller: bool = False          # online mid-scan adaptation
     tuning_interval: float = DEFAULT_TUNING_INTERVAL
+    # fleet replica-poller cadence (0 = off: no poller thread, no parser
+    # import, no fleet gauges); only consulted in --fleet mode
+    fleet_telemetry_interval: float = DEFAULT_FLEET_TELEMETRY_INTERVAL
     topology: str = ""                # fingerprint this config resolved for
     autotune_path: str | None = None  # record file consulted (if any)
     # per-knob provenance: cli | env | autotune | default
@@ -174,6 +184,7 @@ class TuningConfig:
             "compress_min_ratio": self.compress_min_ratio,
             "controller": self.controller,
             "tuning_interval": self.tuning_interval,
+            "fleet_telemetry_interval": self.fleet_telemetry_interval,
             "topology": self.topology,
             "source": dict(self.source),
         }
@@ -388,6 +399,16 @@ def resolve_tuning(opts: dict | None = None, env: dict | None = None,
     if raw_iv is not None:
         cfg.tuning_interval = validate_interval(
             raw_iv, "--tuning-interval/TRIVY_TPU_TUNING_INTERVAL"
+        )
+    # fleet telemetry cadence: same CLI > env > default ladder, explicit 0
+    # (a mode, not an unset value) disables the poller entirely
+    raw_fiv = opts.get("fleet_telemetry_interval")
+    if raw_fiv is None:
+        raw_fiv = env.get("TRIVY_TPU_FLEET_TELEMETRY_INTERVAL") or None
+    if raw_fiv is not None:
+        cfg.fleet_telemetry_interval = validate_interval(
+            raw_fiv,
+            "--fleet-telemetry-interval/TRIVY_TPU_FLEET_TELEMETRY_INTERVAL",
         )
     if record is not None and any(
         s == "autotune" for s in cfg.source.values()
