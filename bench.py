@@ -1630,6 +1630,10 @@ def bench_distributed(rng) -> dict:
             ),
             "redispatches": s_max["redispatches"],
             "shards": s_max["shards"],
+            "splits": s_max.get("splits", 0),
+            "joins": s_max.get("joins", 0),
+            "drains": s_max.get("drains", 0),
+            "placement_decisions": s_max.get("placement_decisions", 0),
             "fleet_telemetry": {
                 "interval_s": (s_max.get("telemetry") or {}).get(
                     "interval_s"
@@ -1698,8 +1702,10 @@ def _chaos_fleet(rng) -> dict:
                     service = httpd.service
                     orig = service.scan
 
+                    # ``slow`` may be a flat delay or a callable keyed on
+                    # the request (per-shard stragglers for the split leg)
                     def wrapped(req, _o=orig, _d=slow, **kw):
-                        time.sleep(_d)
+                        time.sleep(_d(req) if callable(_d) else _d)
                         return _o(req, **kw)
 
                     service.scan = wrapped
@@ -1788,6 +1794,187 @@ def _chaos_fleet(rng) -> dict:
                 "admission gate was not exercised)"
             )
         out["shed_not_crash"] = {"sheds": sheds, "parity": "ok"}
+
+        import threading
+
+        def scan_in_background(art, cache, name):
+            """Start the fleet scan on its own thread and return the
+            (thread, result-box) pair — the elastic legs mutate the fleet
+            mid-sweep, which needs the sweep actually in flight."""
+            box = {}
+
+            def run():
+                try:
+                    box["report"] = Scanner(
+                        art, LocalDriver(cache)
+                    ).scan_artifact(so)
+                except Exception as e:
+                    box["error"] = e
+
+            th = threading.Thread(target=run, name=name)
+            th.start()
+            return th, box
+
+        def await_dispatch(art, deadline_s=30.0):
+            """Block until the coordinator exists and dispatched at least
+            one shard (workers live), so a mid-sweep mutation lands on a
+            running fan-out rather than a not-yet-started one."""
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                coord = art.coordinator
+                if coord is not None and coord.stats.get("dispatches", 0):
+                    return coord
+                time.sleep(0.005)
+            raise RuntimeError("fleet chaos: sweep never started "
+                               "dispatching within the deadline")
+
+        def finish(th, box):
+            th.join(timeout=180)
+            if th.is_alive():
+                raise RuntimeError("fleet chaos: background sweep hung")
+            if "error" in box:
+                raise box["error"]
+            report = box["report"]
+            if [r.to_dict() for r in report.results] != want_results:
+                raise RuntimeError(
+                    "fleet chaos: findings parity broken by an elastic "
+                    "transition"
+                )
+            return report
+
+        # leg 4: live join — the sweep starts on ONE replica; a second
+        # registers mid-sweep and must start stealing immediately. The
+        # injected fleet.register fault first proves a refused join is
+        # loud and leaves the running fan-out untouched.
+        httpds, hosts = spin(2, slow=0.12)
+        try:
+            cache = new_cache("memory", None)
+            art = FleetArtifact(
+                "fs", root, cache, opt,
+                FleetConfig(hosts=[hosts[0]], inflight=1,
+                            shards_per_replica=6, speculate=0.0),
+                so,
+            )
+            th, box = scan_in_background(art, cache, "chaos-join-scan")
+            try:
+                coord = await_dispatch(art)
+                faults.configure(f"fleet.register@{hosts[1]}:at=1:times=1")
+                try:
+                    refused = False
+                    try:
+                        coord.register_replica(hosts[1])
+                    except Exception:
+                        refused = True
+                    if not refused:
+                        raise RuntimeError(
+                            "fleet chaos leg 4: injected fleet.register "
+                            "fault did not refuse the join"
+                        )
+                    coord.register_replica(hosts[1])
+                finally:
+                    faults.clear()
+            finally:
+                report = finish(th, box)
+        finally:
+            for h in httpds:
+                h.shutdown()
+        if report.degraded:
+            raise RuntimeError("fleet chaos leg 4: live join degraded "
+                               "the scan")
+        st = art.stats()
+        if st.get("joins") != 1:
+            raise RuntimeError(
+                f"fleet chaos leg 4: expected exactly 1 recorded join, "
+                f"got {st.get('joins')}"
+            )
+        if st["steals"] < 1:
+            raise RuntimeError(
+                "fleet chaos leg 4: the joined replica never stole work "
+                "(an elastic join that does nothing)"
+            )
+        out["live_join"] = {
+            "joins": st["joins"], "steals": st["steals"], "parity": "ok",
+        }
+
+        # leg 5: drain mid-sweep — replica 0 flips draining and rejects
+        # its queued jobs; the coordinator must take the hand-back, finish
+        # the queued shards elsewhere byte-identically, and never degrade
+        httpds, hosts = spin(2, slow=0.15, max_concurrent_scans=1)
+        try:
+            cache = new_cache("memory", None)
+            art = FleetArtifact(
+                "fs", root, cache, opt,
+                FleetConfig(hosts=list(hosts), inflight=2,
+                            shards_per_replica=4, speculate=0.0),
+                so,
+            )
+            th, box = scan_in_background(art, cache, "chaos-drain-scan")
+            try:
+                await_dispatch(art)
+                # wait for a queued-but-unstarted job on the drain target
+                # (1-scan budget + 2 coordinator workers guarantees one)
+                deadline = time.monotonic() + 30
+                adm = httpds[0].service.admission
+                while (adm.queue_depth() < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                httpds[0].service.draining = True
+                adm.reject_queued()
+            finally:
+                report = finish(th, box)
+        finally:
+            for h in httpds:
+                h.shutdown()
+        if report.degraded:
+            raise RuntimeError("fleet chaos leg 5: drain degraded the "
+                               "scan (survivors should have absorbed it)")
+        st = art.stats()
+        if st.get("drains", 0) < 1:
+            raise RuntimeError(
+                "fleet chaos leg 5: the coordinator never observed the "
+                "drain (no queued-shard hand-back recorded)"
+            )
+        out["drain_handback"] = {
+            "drains": st["drains"],
+            "redispatches": st["redispatches"],
+            "parity": "ok",
+        }
+
+        # leg 6: skewed shard mix — the shard holding pkg11 stalls ~12x
+        # longer than the rest; once the fleet runs dry the straggler must
+        # be split at a directory boundary and re-scattered, findings
+        # byte-identical whichever side of the parent/fragment race wins
+        httpds, hosts = spin(
+            2,
+            slow=lambda req: 1.8 if "pkg11" in repr(req) else 0.04,
+        )
+        try:
+            # telemetry stays off for this leg: the straggler stalls in a
+            # sleep, not device work, so its scraped headroom reads ~1.0
+            # and the owner-headroom veto (correctly) refuses the split.
+            # With no gauge arguing the owner can catch up, the deadline
+            # alone decides — the veto itself is covered by
+            # tests/test_fleet_elastic.py
+            report, art = fleet_scan(
+                hosts, inflight=1, shards_per_replica=2,
+                split_threshold=1.5, speculate_floor_s=0.2,
+                telemetry_interval=0.0,
+            )
+        finally:
+            for h in httpds:
+                h.shutdown()
+        if report.degraded:
+            raise RuntimeError("fleet chaos leg 6: straggler split "
+                               "degraded the scan")
+        st = art.stats()
+        if st.get("splits", 0) < 1:
+            raise RuntimeError(
+                "fleet chaos leg 6: 12x-skewed straggler was never split "
+                "(mid-scan re-planning did not engage)"
+            )
+        out["straggler_split"] = {
+            "splits": st["splits"], "steals": st["steals"], "parity": "ok",
+        }
     import threading as _threading
 
     leaked = [
@@ -2002,10 +2189,25 @@ def _smoke_fleet_off() -> str | None:
         )
     threads = [
         t.name for t in _threading.enumerate()
-        if t.name.startswith(("fleet-worker", "fleet-telemetry"))
+        if t.name.startswith(
+            ("fleet-worker", "fleet-telemetry", "fleet-controller")
+        )
     ]
     if threads:
         return f"fleet-off reps allocated coordinator thread(s): {threads}"
+    # the elastic register seam must be inert on a plain replica server:
+    # a fresh ScanServer carries NO register state (hook unset -> the
+    # /fleet/register route 404s with zero allocation)
+    from trivy_tpu.cache import new_cache as _new_cache
+    from trivy_tpu.rpc.server import ScanServer as _ScanServer
+
+    srv = _ScanServer(_new_cache("memory", None))
+    if srv.fleet_register_hook is not None or srv.fleet_register_token:
+        return (
+            "a fresh ScanServer carries fleet register state — "
+            "/fleet/register must stay a 404 until a coordinator "
+            "installs its hook"
+        )
     from trivy_tpu.rpc.client import pool_stats
 
     ps = pool_stats()

@@ -1,0 +1,905 @@
+"""Elastic fleet control plane: the POST /fleet/register live-join seam
+(HTTP round-trip, token gate, idempotency, dead/draining-joiner refusal),
+drain hand-back with findings parity, mid-scan straggler splitting at
+directory boundaries (Helm subtrees whole) with first-result-wins
+parent/fragment racing, the seeded straggler median, telemetry
+dead-scrape breaker trips, and the headroom-weighted placement
+controller's stability guarantees (dead band, hysteresis, cooldown,
+decision-log replay invariant)."""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from trivy_tpu import faults, obs
+from trivy_tpu.artifact.local_fs import ArtifactOption, LocalFSArtifact
+from trivy_tpu.cache import new_cache
+from trivy_tpu.fleet import FleetError
+from trivy_tpu.fleet import plan as fleet_plan
+from trivy_tpu.fleet.controller import (
+    DEAD_BAND,
+    MAX_WEIGHT,
+    MIN_WEIGHT,
+    WEIGHT_STEP,
+    FleetController,
+    quantize_weight,
+)
+from trivy_tpu.fleet.coordinator import (
+    FleetConfig,
+    FleetCoordinator,
+    _ShardState,
+)
+from trivy_tpu.fleet.merge import FleetArtifact
+from trivy_tpu.fleet.telemetry import DEAD_SCRAPE_STREAK, ReplicaPoller
+from trivy_tpu.rpc.admission import resolve_admission
+from trivy_tpu.rpc.client import RPCError, post_register
+from trivy_tpu.rpc.server import start_server
+from trivy_tpu.scanner import ScanOptions, Scanner
+from trivy_tpu.scanner.local_driver import LocalDriver
+from trivy_tpu.tuning import COOLDOWN_TICKS, HYSTERESIS_TICKS
+
+GHP = "ghp_" + "A1b2C3d4E5f6G7h8I9j0K1l2M3n4O5p6Q7r8"[:36]
+
+SO = ScanOptions(scanners=["secret"])
+OPT = ArtifactOption(backend="cpu")
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_threads():
+    yield
+    left = [
+        t.name for t in threading.enumerate()
+        if t.name.startswith(
+            ("fleet-worker", "fleet-telemetry", "fleet-controller")
+        )
+    ]
+    assert not left, f"leaked fleet thread(s): {left}"
+
+
+def make_tree(base, n_dirs=12) -> str:
+    root = os.path.join(str(base), "tree")
+    for i in range(n_dirs):
+        d = os.path.join(root, f"pkg{i:02d}")
+        os.makedirs(d)
+        with open(os.path.join(d, "cred.txt"), "w") as f:
+            f.write(f"svc{i} token {GHP}\n" * (i + 1))
+        with open(os.path.join(d, "data.py"), "w") as f:
+            f.write(f"print({i})\n" * (20 * (i + 1)))
+    return root
+
+
+def _server(slow=None, max_concurrent_scans=2):
+    """One in-process admission-enabled replica. ``slow`` is a flat delay
+    or a callable keyed on the scan request (per-shard stragglers)."""
+    httpd, port = start_server(
+        cache=new_cache("memory", None),
+        admission=resolve_admission(
+            {"max_concurrent_scans": max_concurrent_scans}
+        ),
+    )
+    if slow is not None:
+        service = httpd.service
+        orig = service.scan
+
+        def wrapped(req, _o=orig, _d=slow, **kw):
+            time.sleep(_d(req) if callable(_d) else _d)
+            return _o(req, **kw)
+
+        service.scan = wrapped
+    return httpd, f"127.0.0.1:{port}"
+
+
+def _shutdown(httpds):
+    for h in httpds:
+        h.shutdown()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_host_fs(root):
+    cache = new_cache("memory", None)
+    art = LocalFSArtifact(root, cache, OPT)
+    return Scanner(art, LocalDriver(cache)).scan_artifact(SO)
+
+
+def _results(report):
+    return [r.to_dict() for r in report.results]
+
+
+def _fleet_scan(root, hosts, **cfg_kw):
+    cfg_kw.setdefault("speculate", 0.0)
+    cfg_kw.setdefault("telemetry_interval", 0.0)
+    cfg = FleetConfig(hosts=list(hosts), **cfg_kw)
+    cache = new_cache("memory", None)
+    art = FleetArtifact("fs", root, cache, OPT, cfg, SO)
+    report = Scanner(art, LocalDriver(cache)).scan_artifact(SO)
+    return report, art
+
+
+def _coordinator(hosts, **cfg_kw):
+    cfg_kw.setdefault("telemetry_interval", 0.0)
+    return FleetCoordinator(
+        FleetConfig(hosts=list(hosts), **cfg_kw), SO
+    )
+
+
+# -- live join: the /fleet/register seam --------------------------------------
+
+
+class TestRegisterSeam:
+    def test_route_is_404_without_a_hook(self):
+        """A plain replica server carries zero register state: the route
+        404s until a coordinator installs its hook."""
+        httpd, host = _server()
+        try:
+            assert httpd.service.fleet_register_hook is None
+            assert httpd.service.fleet_register_token == ""
+            with pytest.raises(RPCError, match="404"):
+                post_register(host, "127.0.0.1:1", retries=0)
+        finally:
+            _shutdown([httpd])
+
+    def test_http_roundtrip_token_and_idempotency(self):
+        """Full seam round-trip: wrong register token → 403; good token →
+        the joiner is probed and adopted; a duplicate re-POST (the
+        joiner's retry ladder) answers Known without a second join."""
+        coord_httpd, coord_host = _server()
+        replica_httpd, replica_host = _server()
+        joiner_httpd, joiner_host = _server()
+        try:
+            coord = _coordinator([replica_host])
+            coord_httpd.service.fleet_register_hook = coord.register_replica
+            coord_httpd.service.fleet_register_token = "sekrit"
+            with pytest.raises(RPCError, match="403"):
+                post_register(
+                    coord_host, joiner_host, token="wrong", retries=0
+                )
+            assert coord.stats["joins"] == 0
+            doc = post_register(coord_host, joiner_host, token="sekrit")
+            assert doc == {
+                "Host": joiner_host, "Known": False, "Replicas": 2,
+            }
+            assert coord.stats["joins"] == 1
+            assert coord.cfg.hosts == [replica_host, joiner_host]
+            # lockstep growth of every per-replica structure
+            assert len(coord.drivers) == 2
+            assert coord.breaker.n == 2
+            assert len(coord._draining) == 2
+            assert len(coord._dead_marks) == 2
+            assert len(coord._sync_only) == 2
+            assert coord._weights[joiner_host] == 1.0
+            dup = post_register(coord_host, joiner_host, token="sekrit")
+            assert dup == {
+                "Host": joiner_host, "Known": True, "Replicas": 2,
+            }
+            assert coord.stats["joins"] == 1
+        finally:
+            _shutdown([coord_httpd, replica_httpd, joiner_httpd])
+
+    def test_bad_body_is_400(self):
+        httpd, host = _server()
+        try:
+            httpd.service.fleet_register_hook = lambda h: {"Host": h}
+            with pytest.raises(RPCError, match="400"):
+                post_register(host, "", retries=0)
+        finally:
+            _shutdown([httpd])
+
+    def test_dead_joiner_is_refused_loudly(self):
+        """The join-time health probe: a joiner that never answers is a
+        FleetError from the hook and a 502 over the wire — the running
+        fan-out is untouched."""
+        replica_httpd, replica_host = _server()
+        coord_httpd, coord_host = _server()
+        dead = f"127.0.0.1:{_free_port()}"
+        try:
+            coord = _coordinator([replica_host])
+            with pytest.raises(FleetError, match="health probe"):
+                coord.register_replica(dead)
+            assert coord.stats["joins"] == 0
+            assert coord.cfg.hosts == [replica_host]
+            coord_httpd.service.fleet_register_hook = coord.register_replica
+            with pytest.raises(RPCError, match="502"):
+                post_register(coord_host, dead, retries=0)
+        finally:
+            _shutdown([replica_httpd, coord_httpd])
+
+    def test_draining_joiner_is_refused(self):
+        replica_httpd, replica_host = _server()
+        joiner_httpd, joiner_host = _server()
+        try:
+            joiner_httpd.service.draining = True
+            coord = _coordinator([replica_host])
+            with pytest.raises(FleetError, match="draining"):
+                coord.register_replica(joiner_host)
+            assert coord.cfg.hosts == [replica_host]
+        finally:
+            _shutdown([replica_httpd, joiner_httpd])
+
+    def test_register_fault_site_refuses(self):
+        replica_httpd, replica_host = _server()
+        joiner_httpd, joiner_host = _server()
+        try:
+            coord = _coordinator([replica_host])
+            faults.configure(f"fleet.register@{joiner_host}:at=1:times=1")
+            with pytest.raises(Exception):
+                coord.register_replica(joiner_host)
+            assert coord.stats["joins"] == 0
+            # the fault is consumed; the retried join succeeds
+            doc = coord.register_replica(joiner_host)
+            assert doc["Known"] is False
+            assert coord.stats["joins"] == 1
+        finally:
+            _shutdown([replica_httpd, joiner_httpd])
+
+    def test_join_mid_sweep_steals_work(self, tmp_path):
+        """A replica registered mid-sweep starts stealing immediately and
+        the merged findings stay byte-identical."""
+        root = make_tree(tmp_path)
+        want = _results(_single_host_fs(root))
+        httpd0, host0 = _server(slow=0.1)
+        httpd1, host1 = _server(slow=0.1)
+        try:
+            cache = new_cache("memory", None)
+            art = FleetArtifact(
+                "fs", root, cache, OPT,
+                FleetConfig(hosts=[host0], inflight=1,
+                            shards_per_replica=6, speculate=0.0,
+                            telemetry_interval=0.0),
+                SO,
+            )
+            box = {}
+
+            def run():
+                try:
+                    box["report"] = Scanner(
+                        art, LocalDriver(cache)
+                    ).scan_artifact(SO)
+                except Exception as e:
+                    box["error"] = e
+
+            th = threading.Thread(target=run, name="elastic-join-scan")
+            th.start()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                c = art.coordinator
+                if c is not None and c.stats.get("dispatches", 0):
+                    break
+                time.sleep(0.005)
+            coord = art.coordinator
+            assert coord is not None, "sweep never started"
+            coord.register_replica(host1)
+            th.join(timeout=120)
+            assert not th.is_alive()
+            assert "error" not in box, box.get("error")
+        finally:
+            _shutdown([httpd0, httpd1])
+        report = box["report"]
+        assert not report.degraded
+        assert _results(report) == want
+        st = art.stats()
+        assert st["joins"] == 1
+        assert st["steals"] >= 1, (
+            "the joined replica never stole work"
+        )
+        assert st["replica_shards"].get(host1, 0) >= 1
+
+
+# -- drain: queued-shard hand-back --------------------------------------------
+
+
+class TestDrainHandback:
+    def test_drain_hands_queued_shards_back_with_parity(self, tmp_path):
+        """Mid-sweep drain: the draining replica's queued jobs come back
+        'rejected … draining'; the coordinator re-scatters them to
+        survivors with no breaker penalty, no degradation, and
+        byte-identical findings."""
+        root = make_tree(tmp_path)
+        want = _results(_single_host_fs(root))
+        httpd0, host0 = _server(slow=0.15, max_concurrent_scans=1)
+        httpd1, host1 = _server(slow=0.15, max_concurrent_scans=1)
+        try:
+            cache = new_cache("memory", None)
+            art = FleetArtifact(
+                "fs", root, cache, OPT,
+                FleetConfig(hosts=[host0, host1], inflight=2,
+                            shards_per_replica=4, speculate=0.0,
+                            telemetry_interval=0.0),
+                SO,
+            )
+            box = {}
+
+            def run():
+                try:
+                    box["report"] = Scanner(
+                        art, LocalDriver(cache)
+                    ).scan_artifact(SO)
+                except Exception as e:
+                    box["error"] = e
+
+            th = threading.Thread(target=run, name="elastic-drain-scan")
+            th.start()
+            adm = httpd0.service.admission
+            deadline = time.monotonic() + 30
+            while (adm.queue_depth() < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            httpd0.service.draining = True
+            adm.reject_queued()
+            th.join(timeout=120)
+            assert not th.is_alive()
+            assert "error" not in box, box.get("error")
+        finally:
+            _shutdown([httpd0, httpd1])
+        report = box["report"]
+        assert not report.degraded, (
+            "a clean drain must be absorbed by survivors, not degrade"
+        )
+        assert _results(report) == want
+        st = art.stats()
+        assert st["drains"] >= 1
+
+    def test_note_draining_rescatters_queue(self):
+        """White-box: note_replica_draining moves the replica's whole
+        queue to survivors, once, idempotently."""
+        coord = _coordinator(["127.0.0.1:1", "127.0.0.1:2"])
+        coord._queues = [[], []]
+        shards = []
+        for i in range(3):
+            s = _ShardState(fleet_plan.ShardSpec(
+                index=i, kind="fs", nbytes=100 * (i + 1),
+                wire={"Kind": "fs"},
+            ))
+            shards.append(s)
+            coord._queues[0].append(s)
+        coord._shards = shards
+        coord.note_replica_draining(0)
+        assert coord._queues[0] == []
+        assert sorted(
+            s.spec.index for s in coord._queues[1]
+        ) == [0, 1, 2]
+        assert coord.stats["drains"] == 1
+        coord.note_replica_draining(0)  # idempotent
+        assert coord.stats["drains"] == 1
+        # a draining replica is skipped by fragment placement
+        extra = _ShardState(fleet_plan.ShardSpec(
+            index=9, kind="fs", nbytes=5, wire={"Kind": "fs"},
+        ))
+        with coord._lock:
+            coord._place_fragment_locked(extra, avoid=set())
+        assert extra in coord._queues[1]
+
+
+# -- mid-scan shard re-planning -----------------------------------------------
+
+
+class TestSplit:
+    def test_split_partitions_paths_deterministically(self, tmp_path):
+        root = make_tree(tmp_path)
+        shards, _, _ = fleet_plan.plan_fs_shards(root, OPT, SO, 2)
+        parent = shards[0]
+        frags = fleet_plan.split_fs_shard(parent, n=2)
+        again = fleet_plan.split_fs_shard(parent, n=2)
+        assert frags is not None and len(frags) == 2
+        # pure function of the tree: replanning yields identical fragments
+        assert [f.wire["Paths"] for f in frags] == [
+            f.wire["Paths"] for f in again
+        ]
+        # exact partition of the parent's unit set — no path lost, none
+        # doubled, so the applier merges byte-identically
+        union = [p for f in frags for p in f.wire["Paths"]]
+        assert sorted(union) == sorted(parent.wire["Paths"])
+        assert len(set(union)) == len(union)
+        # fragment indexes interleave strictly inside the parent's slot
+        for f in frags:
+            assert parent.index < f.index < parent.index + 1
+        assert [f.wire["Bytes"] for f in frags] == [
+            f.nbytes for f in frags
+        ]
+
+    def test_split_keeps_helm_chart_subtree_whole(self, tmp_path):
+        root = os.path.join(str(tmp_path), "tree")
+        chart = os.path.join(root, "chart")
+        os.makedirs(os.path.join(chart, "templates"))
+        with open(os.path.join(chart, "Chart.yaml"), "w") as f:
+            f.write("name: big\n" * 200)
+        with open(os.path.join(chart, "templates", "deploy.yaml"), "w") as f:
+            f.write("kind: Deployment\n" * 400)
+        for i in range(4):
+            d = os.path.join(root, f"lib{i}")
+            os.makedirs(d)
+            with open(os.path.join(d, "a.txt"), "w") as f:
+                f.write("x\n" * 50 * (i + 1))
+        shards, _, _ = fleet_plan.plan_fs_shards(root, OPT, SO, 1)
+        assert len(shards) == 1
+        frags = fleet_plan.split_fs_shard(shards[0], n=2)
+        assert frags is not None
+        holders = [
+            f for f in frags
+            if any(p.startswith("chart/") for p in f.wire["Paths"])
+        ]
+        assert len(holders) == 1, "Helm chart subtree split across shards"
+        chart_paths = [
+            p for p in holders[0].wire["Paths"] if p.startswith("chart/")
+        ]
+        assert sorted(chart_paths) == [
+            "chart/Chart.yaml", "chart/templates/deploy.yaml",
+        ]
+
+    def test_single_unit_shard_is_indivisible(self, tmp_path):
+        root = os.path.join(str(tmp_path), "tree")
+        os.makedirs(os.path.join(root, "only"))
+        with open(os.path.join(root, "only", "a.txt"), "w") as f:
+            f.write("x\n" * 100)
+        shards, _, _ = fleet_plan.plan_fs_shards(root, OPT, SO, 1)
+        assert fleet_plan.split_fs_shard(shards[0], n=2) is None
+
+    def test_parent_win_supersedes_fragments(self):
+        """First-result-wins, parent side: the whole-shard attempt lands
+        first → every fragment is superseded, completed fragment blobs are
+        dropped, queued fragments leave the queues — no path folds twice."""
+        coord = _coordinator(["127.0.0.1:1", "127.0.0.1:2"])
+        coord._queues = [[], []]
+        parent = _ShardState(fleet_plan.ShardSpec(
+            index=0, kind="fs", nbytes=100, wire={"Kind": "fs"},
+        ))
+        c1 = _ShardState(fleet_plan.ShardSpec(
+            index=0.25, kind="fs", nbytes=60, wire={"Kind": "fs"},
+        ))
+        c2 = _ShardState(fleet_plan.ShardSpec(
+            index=0.5, kind="fs", nbytes=40, wire={"Kind": "fs"},
+        ))
+        c1.parent = c2.parent = parent
+        parent.children = [c1, c2]
+        c1.done = True
+        c1.state = "done"
+        c1.blobs = [{"BlobID": "x"}]
+        coord._queues[1].append(c2)
+        coord._shards = [parent, c1, c2]
+        parent.done = True
+        parent.state = "done"
+        with coord._lock:
+            coord._resolve_split_locked(parent)
+        assert c1.resolved_by == "parent" and c1.blobs is None
+        assert c2.resolved_by == "parent" and c2.done
+        assert c2 not in coord._queues[1]
+
+    def test_children_win_resolves_parent(self):
+        """First-result-wins, fragment side: the last fragment landing
+        resolves the parent, whose still-racing attempt cancels on its
+        next poll (done-check)."""
+        coord = _coordinator(["127.0.0.1:1", "127.0.0.1:2"])
+        coord._queues = [[], []]
+        parent = _ShardState(fleet_plan.ShardSpec(
+            index=0, kind="fs", nbytes=100, wire={"Kind": "fs"},
+        ))
+        kids = []
+        for k, nb in enumerate((60, 40)):
+            c = _ShardState(fleet_plan.ShardSpec(
+                index=0.25 * (k + 1), kind="fs", nbytes=nb,
+                wire={"Kind": "fs"},
+            ))
+            c.parent = parent
+            kids.append(c)
+        parent.children = kids
+        coord._shards = [parent] + kids
+        kids[0].done = True
+        kids[0].state = "done"
+        with coord._lock:
+            coord._resolve_split_locked(kids[0])
+        assert not parent.done  # one fragment is not enough
+        kids[1].done = True
+        kids[1].state = "done"
+        with coord._lock:
+            coord._resolve_split_locked(kids[1])
+        assert parent.done and parent.resolved_by == "children"
+        # the poll loop's done-check is what cancels the racing attempt
+        assert coord._pending_locked() == 0
+
+    def test_take_locked_splits_straggler(self, tmp_path):
+        """White-box through the dispatch path: an idle worker with no
+        queue, nothing stealable, and a stalled in-flight fs shard gets
+        the largest fragment of a fresh split; the rest scatter."""
+        root = make_tree(tmp_path, n_dirs=6)
+        shards, _, _ = fleet_plan.plan_fs_shards(root, OPT, SO, 2)
+        coord = _coordinator(
+            ["127.0.0.1:1", "127.0.0.1:2"],
+            split_threshold=0.5, speculate_floor_s=0.05, speculate=0.0,
+        )
+        coord._queues = [[], []]
+        coord._run_started = time.monotonic() - 10.0
+        states = [_ShardState(s) for s in shards]
+        straggler, healthy = states[0], states[1]
+        straggler.state = "inflight"
+        straggler.running = {0}
+        straggler.started = time.monotonic() - 10.0
+        straggler.counted = straggler.spec.nbytes  # walked, stuck in confirm
+        healthy.state = "inflight"
+        healthy.running = {0}
+        healthy.started = time.monotonic() - 1.0
+        healthy.counted = healthy.spec.nbytes  # progressed, not finished
+        coord._shards = states
+        with coord._cond:
+            got, how = coord._take_locked(1)
+        assert how == "split"
+        assert got is not None and got.parent is straggler
+        assert straggler.split and straggler.children is not None
+        assert coord.stats["splits"] == 1
+        # union of fragment paths == the straggler's paths, exactly once
+        union = [
+            p for c in straggler.children for p in c.spec.wire["Paths"]
+        ]
+        assert sorted(union) == sorted(straggler.spec.wire["Paths"])
+        # this worker took the largest fragment; the rest were queued
+        queued = [s for q in coord._queues for s in q]
+        assert len(queued) == len(straggler.children) - 1
+        assert all(s.parent is straggler for s in queued)
+
+    def test_split_fault_site_abandons_split(self, tmp_path):
+        root = make_tree(tmp_path, n_dirs=6)
+        shards, _, _ = fleet_plan.plan_fs_shards(root, OPT, SO, 2)
+        coord = _coordinator(
+            ["127.0.0.1:1", "127.0.0.1:2"],
+            split_threshold=0.5, speculate_floor_s=0.05, speculate=0.0,
+        )
+        coord._queues = [[], []]
+        coord._run_started = time.monotonic() - 10.0
+        s = _ShardState(shards[0])
+        s.state = "inflight"
+        s.running = {0}
+        s.started = time.monotonic() - 10.0
+        s.counted = s.spec.nbytes
+        coord._shards = [s]
+        faults.configure(f"fleet.split@{s.spec.index}:at=1:times=1")
+        with coord._cond:
+            got, how = coord._take_locked(1)
+        assert got is None and how == ""
+        assert s.children is None
+        assert s.split, "a failed split must not be retried forever"
+        assert coord.stats["splits"] == 0
+
+    def test_live_straggler_split_with_parity(self, tmp_path):
+        """Integration: a ~30x straggler shard on a 2-replica fleet is
+        split mid-scan and the merged findings stay byte-identical
+        whichever side of the parent/fragment race lands first."""
+        root = make_tree(tmp_path)
+        want = _results(_single_host_fs(root))
+
+        def delay(req):
+            return 1.5 if "pkg11" in repr(req) else 0.04
+
+        httpd0, host0 = _server(slow=delay)
+        httpd1, host1 = _server(slow=delay)
+        try:
+            report, art = _fleet_scan(
+                root, [host0, host1], inflight=1, shards_per_replica=2,
+                split_threshold=1.5, speculate_floor_s=0.2,
+            )
+        finally:
+            _shutdown([httpd0, httpd1])
+        assert not report.degraded
+        assert _results(report) == want
+        assert art.stats()["splits"] >= 1
+
+
+# -- seeded straggler median --------------------------------------------------
+
+
+class TestSeededMedian:
+    def test_completed_walls_still_win(self):
+        coord = _coordinator(["127.0.0.1:1"])
+        coord._durations = [2.0, 4.0, 6.0]
+        with coord._lock:
+            assert coord._median_wall_locked() == 4.0
+
+    def test_seeded_from_planner_bytes_before_any_completion(self):
+        """Regression: a 2-shard plan with shard 0 stalled used to have
+        NO median until a shard completed — the straggler could never be
+        split. The seed derives one from planner bytes over observed
+        progress throughput."""
+        coord = _coordinator(["127.0.0.1:1", "127.0.0.1:2"])
+        coord._run_started = time.monotonic() - 1.0
+        a = _ShardState(fleet_plan.ShardSpec(
+            index=0, kind="fs", nbytes=1000, wire={"Kind": "fs"},
+        ))
+        b = _ShardState(fleet_plan.ShardSpec(
+            index=1, kind="fs", nbytes=1000, wire={"Kind": "fs"},
+        ))
+        b.counted = 500  # half the healthy shard in ~1s
+        coord._shards = [a, b]
+        with coord._lock:
+            med = coord._median_wall_locked()
+        # throughput ~500 B/s -> a median-sized (1000 B) shard ~2s
+        assert med is not None and 1.5 < med < 3.0
+        # fragments never feed the seed twice: only parent-less shards
+        b.parent = a
+        with coord._lock:
+            med2 = coord._median_wall_locked()
+        assert med2 is None  # a's counted is 0 and b is a fragment
+
+    def test_no_progress_means_no_estimate(self):
+        coord = _coordinator(["127.0.0.1:1"])
+        coord._run_started = time.monotonic() - 5.0
+        coord._shards = [_ShardState(fleet_plan.ShardSpec(
+            index=0, kind="fs", nbytes=1000, wire={"Kind": "fs"},
+        ))]
+        with coord._lock:
+            assert coord._median_wall_locked() is None
+
+    def test_two_shard_stall_is_actionable_before_any_completion(
+        self, tmp_path
+    ):
+        """The full regression path: 2-shard plan, shard 0 stalls, shard
+        1 reports progress but nothing has COMPLETED — the split path
+        must still engage off the seeded median."""
+        root = make_tree(tmp_path, n_dirs=6)
+        shards, _, _ = fleet_plan.plan_fs_shards(root, OPT, SO, 2)
+        assert len(shards) == 2
+        coord = _coordinator(
+            ["127.0.0.1:1", "127.0.0.1:2"],
+            split_threshold=1.5, speculate_floor_s=0.05, speculate=0.0,
+        )
+        coord._queues = [[], []]
+        coord._run_started = time.monotonic() - 10.0
+        stalled, healthy = _ShardState(shards[0]), _ShardState(shards[1])
+        for s, started in ((stalled, 10.0), (healthy, 1.0)):
+            s.state = "inflight"
+            s.running = {0}
+            s.started = time.monotonic() - started
+        # both shards reported walk progress; NOTHING has completed
+        stalled.counted = stalled.spec.nbytes
+        healthy.counted = healthy.spec.nbytes
+        coord._shards = [stalled, healthy]
+        assert coord._durations == []  # nothing completed
+        with coord._cond:
+            got, how = coord._take_locked(1)
+        assert how == "split" and got.parent is stalled
+
+
+# -- telemetry dead-scrape trip -----------------------------------------------
+
+
+class TestDeadScrapeTrip:
+    def test_two_dead_scrapes_trip_the_breaker(self):
+        """A replica that took work and died: after DEAD_SCRAPE_STREAK
+        consecutive failed scrapes the poller trips that replica's
+        breaker and dead-marks it so in-flight result polls abandon
+        immediately instead of waiting out the job timeout."""
+        httpd, live_host = _server()
+        killed_httpd, killed_host = _server()
+        # "kill" the replica: stop serving and close the socket so
+        # scrapes see connection-refused, exactly like a dead process
+        killed_httpd.shutdown()
+        killed_httpd.server_close()
+        try:
+            coord = _coordinator([live_host, killed_host])
+            with obs.scan_context(name="dead-scrape", enabled=False) as ctx:
+                poller = ReplicaPoller(coord, ctx, interval=0.05)
+                try:
+                    for _ in range(DEAD_SCRAPE_STREAK):
+                        poller.scrape_once()
+                    assert coord._dead_marks[1] is True
+                    assert coord.breaker.is_open(1)
+                    # the live replica stays healthy and unmarked
+                    assert coord._dead_marks[0] is False
+                    assert not coord.breaker.is_open(0)
+                    assert poller._dead_streaks[live_host] == 0
+                    # a dead-marked replica's result poll abandons NOW
+                    shard = _ShardState(fleet_plan.ShardSpec(
+                        index=0, kind="fs", nbytes=10,
+                        wire={"Kind": "fs"},
+                    ))
+                    with pytest.raises(RPCError, match="declared dead"):
+                        coord._poll_result_inner(
+                            1, shard, "deadbeef", ctx,
+                            coord.drivers[1], RPCError,
+                        )
+                    # recovery: an alive note clears the mark; the
+                    # breaker's own half-open ladder governs re-entry
+                    coord.note_replica_alive(1)
+                    assert coord._dead_marks[1] is False
+                finally:
+                    poller.stop()
+        finally:
+            _shutdown([httpd])
+
+    def test_single_failed_scrape_does_not_trip(self):
+        httpd, live_host = _server()
+        dead_host = f"127.0.0.1:{_free_port()}"
+        try:
+            coord = _coordinator([live_host, dead_host])
+            with obs.scan_context(name="one-miss", enabled=False) as ctx:
+                poller = ReplicaPoller(coord, ctx, interval=0.05)
+                try:
+                    poller.scrape_once()
+                    assert poller._dead_streaks[dead_host] == 1
+                    assert coord._dead_marks[1] is False
+                    assert not coord.breaker.is_open(1)
+                finally:
+                    poller.stop()
+        finally:
+            _shutdown([httpd])
+
+    def test_draining_gauge_triggers_handback(self):
+        """The poller reads trivy_tpu_server_draining from a draining
+        replica's still-answering /metrics and hands its queue back
+        before any rejected-job round trip lands."""
+        httpd0, host0 = _server()
+        httpd1, host1 = _server()
+        try:
+            httpd0.service.draining = True
+            coord = _coordinator([host0, host1])
+            coord._queues = [[], []]
+            s = _ShardState(fleet_plan.ShardSpec(
+                index=0, kind="fs", nbytes=10, wire={"Kind": "fs"},
+            ))
+            coord._queues[0].append(s)
+            coord._shards = [s]
+            with obs.scan_context(name="drain-gauge", enabled=False) as ctx:
+                poller = ReplicaPoller(coord, ctx, interval=0.05)
+                try:
+                    poller.scrape_once()
+                    assert coord._draining[0] is True
+                    assert s in coord._queues[1]
+                    assert coord.stats["drains"] == 1
+                finally:
+                    poller.stop()
+        finally:
+            _shutdown([httpd0, httpd1])
+
+
+# -- headroom-weighted placement controller -----------------------------------
+
+
+class TestController:
+    def test_quantize_ladder(self):
+        assert quantize_weight(0.0) == MIN_WEIGHT
+        assert quantize_weight(0.3) == 0.25
+        assert quantize_weight(0.4) == 0.5
+        assert quantize_weight(0.74) == 0.75
+        assert quantize_weight(1.0) == MAX_WEIGHT
+        assert quantize_weight(9.9) == MAX_WEIGHT
+
+    def test_hysteresis_one_outlier_never_fires(self):
+        c = FleetController(["r0"])
+        assert c.step({"r0": 0.2}) == []  # proposed, streak 1
+        for _ in range(10):
+            assert c.step({"r0": 1.0}) == []  # outlier cleared
+        assert c.weights() == {"r0": MAX_WEIGHT}
+        assert len(c.decisions) == 0
+
+    def test_convergence_fixed_point_no_oscillation(self):
+        """A persistent low-headroom feed fires exactly one re-weight
+        (after 2-tick hysteresis), then reaches a fixed point: the same
+        feed never fires again — provably no oscillation."""
+        c = FleetController(["r0", "r1"])
+        fired_total = []
+        for _ in range(40):
+            fired_total += c.step({"r0": 0.2, "r1": 0.95})
+        assert len(fired_total) == 1
+        d = fired_total[0]
+        assert d["knob"] == "weight:r0"
+        assert d["from"] == MAX_WEIGHT and d["to"] == 0.25
+        assert d["gauges"] == {"r0": 0.2, "r1": 0.95}
+        assert c.weights() == {"r0": 0.25, "r1": MAX_WEIGHT}
+
+    def test_dead_band_noise_proposes_nothing(self):
+        """Gauge noise within half a rung plus the dead band around the
+        current weight never even proposes a re-weight."""
+        c = FleetController(["r0"])
+        amp = WEIGHT_STEP / 2 + DEAD_BAND  # boundary, inclusive
+        feeds = [1.0, 1.0 - amp, 1.0, 1.0 - amp / 2] * 15
+        for h in feeds:
+            assert c.step({"r0": h}) == []
+        assert c.weights() == {"r0": MAX_WEIGHT}
+
+    def test_cooldown_holds_after_fire(self):
+        c = FleetController(["r0"])
+        c.step({"r0": 0.2})
+        fired = c.step({"r0": 0.2})
+        assert len(fired) == 1
+        # during cooldown even a persistent opposite feed holds still
+        for _ in range(COOLDOWN_TICKS):
+            assert c.step({"r0": 1.0}) == []
+            assert c.weights()["r0"] == 0.25
+        # after cooldown, hysteresis applies afresh
+        for _ in range(HYSTERESIS_TICKS):
+            c.step({"r0": 1.0})
+        assert c.weights()["r0"] == MAX_WEIGHT
+
+    def test_absent_host_holds_weight(self):
+        c = FleetController(["r0", "r1"])
+        for _ in range(5):
+            c.step({"r0": 0.2})  # r1 absent from the snapshot
+        assert c.weights()["r1"] == MAX_WEIGHT
+
+    def test_replay_invariant_with_mid_stream_join(self):
+        """Decision-log replay: per-knob weight deltas sum exactly to
+        final - initial, including a host added mid-stream."""
+        c = FleetController(["r0", "r1"])
+        feeds = (
+            [{"r0": 0.2, "r1": 0.95}] * 4
+            + [{"r0": 0.95, "r1": 0.45}] * 6
+        )
+        for f in feeds[:5]:
+            c.step(f)
+        c.add_host("r2")
+        for f in feeds[5:]:
+            c.step(f)
+        for _ in range(6):
+            c.step({"r0": 0.95, "r1": 0.45, "r2": 0.45})
+        doc = c.doc()
+        deltas: dict[str, float] = {}
+        for d in doc["decision_log"]:
+            host = d["knob"].split(":", 1)[1]
+            deltas[host] = deltas.get(host, 0.0) + (d["to"] - d["from"])
+        for host, final in doc["final"].items():
+            assert round(
+                doc["initial"][host] + deltas.get(host, 0.0), 6
+            ) == final
+
+    def test_tick_counts_decisions_on_context(self):
+        with obs.scan_context(name="ctrl", enabled=True) as ctx:
+            c = FleetController(["r0"], ctx=ctx, interval=0.05)
+            c.tick({"r0": 0.2})
+            c.tick({"r0": 0.2})
+            assert c.weights()["r0"] == 0.25
+            assert len(c.decisions) == 1
+
+
+# -- weighted placement in the coordinator ------------------------------------
+
+
+class TestWeightedPlacement:
+    def test_weighted_target_prefers_headroom(self):
+        """Equal queued bytes: the down-weighted (drowning) replica looks
+        fuller, so new placement goes to the full-weight one."""
+        coord = _coordinator(["127.0.0.1:1", "127.0.0.1:2"])
+        coord._queues = [[], []]
+        for j in range(2):
+            s = _ShardState(fleet_plan.ShardSpec(
+                index=j, kind="fs", nbytes=100, wire={"Kind": "fs"},
+            ))
+            coord._queues[j].append(s)
+        coord.apply_placement(
+            {"127.0.0.1:1": 0.25, "127.0.0.1:2": 1.0}, fired=1
+        )
+        assert coord.stats["placement_decisions"] == 1
+        with coord._lock:
+            assert coord._weighted_target_locked([0, 1]) == 1
+
+    def test_steal_prefers_weighted_heaviest_donor(self):
+        """Donor order is weighted: with equally sized stealable shards,
+        the down-weighted (drowning) replica sheds first."""
+        coord = _coordinator(
+            ["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"]
+        )
+        coord._queues = [[], [], []]
+        drowning = _ShardState(fleet_plan.ShardSpec(
+            index=0, kind="fs", nbytes=100, wire={"Kind": "fs"},
+        ))
+        healthy = _ShardState(fleet_plan.ShardSpec(
+            index=1, kind="fs", nbytes=100, wire={"Kind": "fs"},
+        ))
+        coord._queues[0].append(drowning)  # weighted load 100/0.25 = 400
+        coord._queues[1].append(healthy)   # weighted load 100/1.0 = 100
+        coord._shards = [drowning, healthy]
+        coord.apply_placement({"127.0.0.1:1": 0.25, "127.0.0.1:2": 1.0,
+                               "127.0.0.1:3": 1.0})
+        with coord._lock:
+            got, how = coord._take_locked(2)
+        assert how == "steal" and got is drowning
+        assert coord.stats["steals"] == 1

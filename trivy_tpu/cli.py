@@ -476,6 +476,21 @@ def fleet_flags() -> FlagGroup:
                       "(default 1.0; 0 disables the poller entirely — no "
                       "thread, no fleet gauges; env "
                       "TRIVY_TPU_FLEET_TELEMETRY_INTERVAL)"),
+            Flag("fleet-split-threshold", default=None, value_type=float,
+                 config_name="fleet.split-threshold",
+                 validator=_interval_validator,
+                 help="mid-scan re-planning multiplier: an in-flight fs "
+                      "shard running past this x the median shard wall "
+                      "while its replica has no headroom is split at a "
+                      "directory boundary and the fragments re-scattered "
+                      "(default 3.0 — above --fleet-speculate, a twin is "
+                      "cheaper than a re-plan; 0 disables; env "
+                      "TRIVY_TPU_FLEET_SPLIT_THRESHOLD)"),
+            Flag("fleet-register-token", default=None,
+                 config_name="fleet.register-token",
+                 help="dedicated bearer token for the POST /fleet/register "
+                      "live-join seam (default: the scan --token gates it; "
+                      "a bad token answers 403)"),
         ],
     )
 

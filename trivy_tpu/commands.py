@@ -61,6 +61,7 @@ def _resolve_tuning(opts):
         "tuning_controller": opts.get("tune") or None,
         "tuning_interval": opts.get("tuning_interval"),
         "fleet_telemetry_interval": opts.get("fleet_telemetry_interval"),
+        "fleet_split_threshold": opts.get("fleet_split_threshold"),
     })
     obs.current().tuning = {"config": cfg.to_dict()}
     return cfg

@@ -30,6 +30,16 @@ Instrumented sites (key in parentheses):
   queued shard (a fault here must requeue the shard, never lose it)
 - ``fleet.result`` (shard index) — coordinator-side shard result fold (a
   fault here counts as a failed attempt and re-dispatches that one shard)
+- ``fleet.register`` (joining replica address) — live replica join on the
+  elastic control plane (a fault here must refuse the join loudly and
+  leave the running fan-out untouched)
+- ``fleet.drain`` (draining replica address) — queued-shard hand-back
+  when a replica reports draining (a fault here must fall back to the
+  breaker ladder — the shard re-dispatches as a plain failure, never
+  lost, never double-completed)
+- ``fleet.split`` (shard index) — mid-scan straggler split at a
+  directory boundary (a fault here must abandon the split and leave the
+  original in-flight attempt racing as before)
 
 Spec grammar (``--fault-inject`` / ``TRIVY_TPU_FAULT_INJECT``), clauses
 comma-separated::

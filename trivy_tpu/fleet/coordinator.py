@@ -40,8 +40,11 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import faults, log, obs
 from trivy_tpu.fleet import FleetError, parse_fleet
-from trivy_tpu.fleet.plan import DEFAULT_SHARDS_PER_REPLICA
-from trivy_tpu.tuning import DEFAULT_FLEET_TELEMETRY_INTERVAL
+from trivy_tpu.fleet.plan import DEFAULT_SHARDS_PER_REPLICA, split_fs_shard
+from trivy_tpu.tuning import (
+    DEFAULT_FLEET_SPLIT_THRESHOLD,
+    DEFAULT_FLEET_TELEMETRY_INTERVAL,
+)
 
 logger = log.logger("fleet:coordinator")
 
@@ -52,6 +55,18 @@ DEFAULT_JOB_TIMEOUT = 600.0  # per-shard attempt wall cap
 DEFAULT_RUN_TIMEOUT = 3600.0  # whole-fan-out wall cap
 RESULT_POLL_S = 0.1
 PROGRESS_EVERY_POLLS = 5  # fold replica progress every Nth result poll
+# a straggler split must leave a replica no headroom to hide behind: only
+# shards whose owning replica scores at or below this (the far side of the
+# tuning dead band — busy >= SHRINK_BUSY_MIN with an empty queue) are
+# split; an unknown headroom (telemetry off) counts as none
+SPLIT_HEADROOM_MAX = 0.05
+
+
+class ReplicaDraining(Exception):
+    """A replica rejected a queued shard because it is draining (SIGTERM
+    → ``"draining"`` on ``/healthz``): hand the shard back for re-dispatch
+    WITHOUT a breaker penalty — the replica is shutting down cleanly, not
+    failing."""
 
 
 @dataclass
@@ -81,6 +96,14 @@ class FleetConfig:
     # replica health-poll cadence (fleet telemetry plane); 0 disables the
     # poller entirely — no thread, no telemetry import, no fleet gauges
     telemetry_interval: float = DEFAULT_FLEET_TELEMETRY_INTERVAL
+    # mid-scan re-planning: an in-flight fs shard running past
+    # ``split_threshold ×`` the median shard wall (floor
+    # ``speculate_floor_s``) while its owner has no headroom is split at
+    # a directory boundary and the remainder re-scattered; 0 disables
+    split_threshold: float = DEFAULT_FLEET_SPLIT_THRESHOLD
+    # bearer token a POST /fleet/register must present on the live-join
+    # seam; empty falls back to the scan token (same _token_ok path)
+    register_token: str = ""
 
     @classmethod
     def from_opts(cls, opts: dict, tuning=None) -> "FleetConfig":
@@ -114,6 +137,16 @@ class FleetConfig:
                 DEFAULT_FLEET_TELEMETRY_INTERVAL,
             )
         cfg.telemetry_interval = max(0.0, float(tiv))
+        # same explicit-0-wins shape for the split threshold ("elastic
+        # re-planning off" is a decision, not absence)
+        fst = opts.get("fleet_split_threshold")
+        if fst is None:
+            fst = getattr(
+                tuning, "fleet_split_threshold",
+                DEFAULT_FLEET_SPLIT_THRESHOLD,
+            )
+        cfg.split_threshold = max(0.0, float(fst))
+        cfg.register_token = opts.get("fleet_register_token") or ""
         return cfg
 
     def target_shards(self) -> int:
@@ -138,6 +171,7 @@ class _ShardState:
     __slots__ = (
         "spec", "state", "running", "failed_on", "attempts", "started",
         "speculated", "stolen", "done", "blobs", "counted",
+        "split", "parent", "children", "resolved_by",
     )
 
     def __init__(self, spec):
@@ -152,6 +186,16 @@ class _ShardState:
         self.done = False
         self.blobs: list | None = None
         self.counted = 0  # replica-reported bytes already folded into progress
+        # mid-scan re-planning: a straggler split spawns fragment states
+        # whose union of paths is exactly the parent's — the parent's
+        # whole-shard attempt keeps racing the fragment group, and the
+        # first side to complete wins ("self" via its own attempt,
+        # "children" when every fragment lands first, "parent" stamped on
+        # fragments a parent win superseded)
+        self.split = False  # a split was attempted (never re-split)
+        self.parent: "_ShardState | None" = None
+        self.children: "list[_ShardState] | None" = None
+        self.resolved_by = "self"
 
 
 class FleetCoordinator:
@@ -186,6 +230,10 @@ class FleetCoordinator:
             "cancelled": 0,
             "local_fallback": 0,
             "warm_seeded": 0,  # replicas sent a warm dedup payload
+            "splits": 0,  # stragglers split at a directory boundary
+            "joins": 0,  # replicas that registered mid-sweep
+            "drains": 0,  # replicas that handed queued work back
+            "placement_decisions": 0,  # controller re-weights applied
             "replica_shards": {h: 0 for h in cfg.hosts},
         }
         self._warm_sent: set[int] = set()
@@ -203,12 +251,139 @@ class FleetCoordinator:
         self._host_last_done: dict[str, float] = {}
         self._run_started = 0.0
         self.verdict: dict[str, dict] = {}  # set at fan-out end
+        # elastic control plane (all grown in lockstep by register_replica):
+        # draining replicas take no new work, dead-marked replicas abandon
+        # their in-flight polls NOW, weights bias requeue/steal placement
+        self._draining = [False] * len(cfg.hosts)
+        self._dead_marks = [False] * len(cfg.hosts)
+        self._weights: dict[str, float] = {h: 1.0 for h in cfg.hosts}
+        self._workers: list[threading.Thread] = []
+        self._running = False
+        self._ctx = None
+        self.controller = None  # FleetController when telemetry is on
+        self._poller = None  # ReplicaPoller when telemetry is on
 
     def active_jobs(self, host: str) -> list[str]:
         """Snapshot of the job ids currently polling on ``host`` — the
         telemetry poller's progress-scrape targets."""
         with self._lock:
             return list(self._active_jobs.get(host, ()))
+
+    # -- elastic control plane ----------------------------------------------
+
+    def register_replica(self, host: str) -> dict:
+        """Live join: a replica appearing mid-sweep is validated, probed,
+        and then every per-replica structure grows in lockstep under the
+        lock — breaker slot, affinity queue, workers — so it starts
+        stealing work immediately. Idempotent on duplicates (the joiner's
+        retry ladder may re-POST); a joiner that fails its health probe
+        (or arrives already draining) is refused loudly and the running
+        fan-out is untouched."""
+        hosts = parse_fleet(host)
+        if len(hosts) != 1:
+            raise FleetError(
+                f"register: exactly one replica address required, "
+                f"got {host!r}"
+            )
+        host = hosts[0]
+        faults.check("fleet.register", key=host)
+        with self._lock:
+            if host in self.cfg.hosts:
+                return {"Host": host, "Known": True,
+                        "Replicas": len(self.cfg.hosts)}
+        from trivy_tpu.rpc.client import RemoteDriver, get_healthz
+
+        # probe OUTSIDE the lock — a dead joiner must not stall dispatch
+        try:
+            hz = get_healthz(host, deadline=self.cfg.rpc_deadline)
+        except Exception as e:
+            raise FleetError(
+                f"register: health probe of {host} failed: {e}"
+            ) from e
+        if (hz or {}).get("Status") == "draining":
+            raise FleetError(
+                f"register: {host} is draining; refusing the join"
+            )
+        driver = RemoteDriver(
+            host, token=self.cfg.token, retries=self.cfg.rpc_retries,
+            deadline=self.cfg.rpc_deadline,
+        )
+        with self._cond:
+            if host in self.cfg.hosts:  # lost a duplicate-register race
+                return {"Host": host, "Known": True,
+                        "Replicas": len(self.cfg.hosts)}
+            i = len(self.cfg.hosts)
+            self.cfg.hosts.append(host)
+            self.drivers.append(driver)
+            self.breaker.grow(f"fleet:{host}")
+            self._sync_only.append(False)
+            self._draining.append(False)
+            self._dead_marks.append(False)
+            self._queues.append([])
+            self._active_jobs[host] = set()
+            self._host_busy[host] = 0.0
+            self._weights[host] = 1.0
+            self.stats["replicas"] = len(self.cfg.hosts)
+            self.stats["joins"] += 1
+            self.stats["replica_shards"].setdefault(host, 0)
+            if self._running and not self._stop:
+                ws = [
+                    threading.Thread(
+                        target=self._worker, args=(i, self._ctx),
+                        daemon=True, name=f"fleet-worker-r{i}-{j}",
+                    )
+                    for j in range(self.cfg.inflight)
+                ]
+                self._workers.extend(ws)
+                for w in ws:
+                    w.start()
+            self._cond.notify_all()
+        if self.controller is not None:
+            self.controller.add_host(host)
+        if self._ctx is not None:
+            self._ctx.count("fleet.joins")
+        logger.info(
+            "replica %s joined the fleet mid-sweep (now %d replica(s))",
+            host, len(self.cfg.hosts),
+        )
+        return {"Host": host, "Known": False,
+                "Replicas": len(self.cfg.hosts)}
+
+    def note_replica_draining(self, i: int) -> None:
+        """Telemetry verdict: replica ``i`` scraped as draining — hand its
+        queued shards back and stop assigning it work."""
+        with self._cond:
+            self._note_draining_locked(i)
+            self._cond.notify_all()
+
+    def note_replica_dead(self, i: int, reason: str = "") -> None:
+        """Telemetry verdict (2 consecutive failed scrapes): trip the
+        breaker NOW and mark the replica so in-flight result polls on it
+        abandon immediately instead of waiting out the job timeout — the
+        fix for a replica that takes work and dies leaving its shard
+        parked in ``dispatched``."""
+        with self._cond:
+            if i >= len(self._dead_marks) or self._dead_marks[i]:
+                return
+            self._dead_marks[i] = True
+            self._cond.notify_all()
+        self.breaker.trip(i, reason or "2 consecutive dead telemetry scrapes")
+
+    def note_replica_alive(self, i: int) -> None:
+        """A successful scrape (or attempt) on a dead-marked replica: the
+        mark clears; the breaker's own half-open ladder decides re-entry."""
+        with self._lock:
+            if i < len(self._dead_marks):
+                self._dead_marks[i] = False
+
+    def apply_placement(self, weights: dict, fired: int = 0) -> None:
+        """Controller output: swap in the placement weights consulted by
+        requeue targeting and steal ordering, and account fired
+        decisions."""
+        with self._lock:
+            self._weights = dict(weights)
+            if fired:
+                self.stats["placement_decisions"] += fired
 
     # -- queue mechanics (all under self._lock) ------------------------------
 
@@ -220,19 +395,50 @@ class FleetCoordinator:
         q.append(shard)
 
     def _pending_locked(self) -> int:
-        return sum(1 for s in self._shards if s.state not in ("done", "dead"))
+        n = 0
+        for s in self._shards:
+            if s.state in ("done", "dead"):
+                continue
+            if s.children is not None and not s.running and all(
+                c.state in ("done", "dead") for c in s.children
+            ):
+                # a split parent with no racing attempt of its own is
+                # settled by its fragments (a dead fragment completes in
+                # the post-loop fallback, which resolves the parent)
+                continue
+            n += 1
+        return n
+
+    def _median_wall_locked(self) -> float | None:
+        """Median shard wall for straggler deadlines. Before ANY shard has
+        completed, seed the estimate from planner byte sizes over the
+        observed progress throughput — a 2-shard plan with one stalled
+        shard must still speculate/split the straggler (the completed-only
+        median left it unactionable forever)."""
+        if self._durations:
+            return statistics.median(self._durations)
+        counted = sum(s.counted for s in self._shards if s.parent is None)
+        elapsed = time.monotonic() - self._run_started
+        if counted <= 0 or elapsed <= 0:
+            return None
+        sizes = [
+            s.spec.nbytes for s in self._shards
+            if s.parent is None and s.spec.nbytes > 0
+        ]
+        if not sizes:
+            return None
+        return statistics.median(sizes) * elapsed / counted
 
     def _speculate_deadline_locked(self) -> float:
-        if self._durations:
-            return max(
-                self.cfg.speculate_floor_s,
-                self.cfg.speculate * statistics.median(self._durations),
-            )
+        med = self._median_wall_locked()
+        if med is not None:
+            return max(self.cfg.speculate_floor_s, self.cfg.speculate * med)
         return self.cfg.speculate_floor_s
 
     def _take_locked(self, i: int) -> tuple[_ShardState | None, str]:
         """Next shard for replica ``i``: own largest → stolen largest from
-        the most-loaded peer → speculative twin of the worst straggler."""
+        the most-loaded peer → largest fragment of a freshly split
+        straggler → speculative twin of the worst straggler."""
         q = self._queues[i]
         if q:
             return q.pop(0), "own"
@@ -244,12 +450,15 @@ class FleetCoordinator:
             # desc, so each queue's first eligible entry is its largest);
             # shards this replica already failed on are not stealable —
             # stealing back a shard that was deliberately requeued AWAY
-            # from us would burn attempts on a known-bad pairing
+            # from us would burn attempts on a known-bad pairing; donors
+            # rank by weighted queued bytes so a drowning replica sheds
+            # first
             best = None
             best_j = -1
             for j in sorted(
                 donors,
-                key=lambda j: -sum(s.spec.nbytes for s in self._queues[j]),
+                key=lambda j: -sum(s.spec.nbytes for s in self._queues[j])
+                / max(0.05, self._weights.get(self.cfg.hosts[j], 1.0)),
             ):
                 for s in self._queues[j]:
                     if i in s.failed_on:
@@ -262,12 +471,16 @@ class FleetCoordinator:
                 best.stolen = True
                 self.stats["steals"] += 1
                 return best, "steal"
+        split = self._try_split_locked(i)
+        if split is not None:
+            return split, "split"
         if self.cfg.speculate > 0:
             now = time.monotonic()
             deadline = self._speculate_deadline_locked()
             cands = [
                 s for s in self._shards
                 if s.state == "inflight" and not s.done and not s.speculated
+                and s.children is None
                 and i not in s.running and i not in s.failed_on
                 and now - s.started > deadline
             ]
@@ -278,12 +491,89 @@ class FleetCoordinator:
                 return shard, "speculate"
         return None, ""
 
+    def _owner_headroom_locked(self, s: _ShardState) -> float:
+        """Best headroom among the replicas currently running ``s`` — a
+        split only fires when even the most-relieved owner is out of
+        headroom. No telemetry (poller off, host never scraped) reads as
+        0.0: with no gauge arguing the owner can catch up, the straggler
+        deadline alone decides."""
+        p = self._poller
+        if p is None or not s.running:
+            return 0.0
+        hs = []
+        for j in s.running:
+            if j < len(self.cfg.hosts):
+                rh = p.health.get(self.cfg.hosts[j])
+                hs.append(rh.headroom() if rh is not None else 0.0)
+        return max(hs) if hs else 0.0
+
+    def _try_split_locked(self, i: int) -> _ShardState | None:
+        """Mid-scan re-planning: when an in-flight fs shard's wall exceeds
+        ``split_threshold ×`` the median and its owner has no headroom,
+        split it at a directory boundary (Helm subtrees stay whole),
+        scatter the fragments to survivors, and hand the largest to this
+        worker. The parent's attempt keeps racing the fragment group —
+        first side to finish wins, so a split can never lose work."""
+        if self.cfg.split_threshold <= 0:
+            return None
+        med = self._median_wall_locked()
+        if med is None:
+            return None
+        now = time.monotonic()
+        deadline = max(
+            self.cfg.speculate_floor_s, self.cfg.split_threshold * med
+        )
+        cands = [
+            s for s in self._shards
+            if s.state == "inflight" and not s.done and not s.split
+            and s.children is None and s.parent is None
+            and s.spec.wire.get("Kind") == "fs"
+            and i not in s.running and i not in s.failed_on
+            and now - s.started > deadline
+            and self._owner_headroom_locked(s) <= SPLIT_HEADROOM_MAX
+        ]
+        if not cands:
+            return None
+        shard = min(cands, key=lambda s: s.started)  # worst straggler
+        shard.split = True  # one split per shard, even if it fails below
+        try:
+            faults.check("fleet.split", key=str(shard.spec.index))
+            frags = split_fs_shard(shard.spec, n=2)
+        except Exception as e:
+            logger.warning(
+                "split of %s abandoned: %s (original attempt keeps racing)",
+                shard.spec.label(), e,
+            )
+            return None
+        if not frags:
+            return None  # indivisible (single planning unit)
+        children = []
+        for spec in frags:
+            c = _ShardState(spec)
+            c.parent = shard
+            children.append(c)
+        shard.children = children
+        self._shards.extend(children)
+        self.stats["splits"] += 1
+        logger.info(
+            "straggler %s split into %d fragment(s) after %.1fs "
+            "(median %.1fs)", shard.spec.label(), len(children),
+            now - shard.started, med,
+        )
+        # largest fragment goes to this (idle) worker; the rest scatter
+        # to survivors, weighted, avoiding the straggler's own owners
+        for c in children[1:]:
+            self._place_fragment_locked(c, avoid=shard.running | {i})
+        self._cond.notify_all()
+        return children[0]
+
     def _eligible_work_locked(self, i: int) -> bool:
         """Would :meth:`_take_locked` yield anything for replica ``i``?
         Mirrors its filters without popping — the breaker's half-open
         probe slot must only be claimed when there is an attempt to spend
         it on (an empty-handed claim locks recovery out for the whole
-        probe timeout)."""
+        probe timeout). Splits are deliberately NOT mirrored: a probe
+        slot is too scarce to spend on re-planning someone else's shard."""
         if self._queues[i]:
             return True
         for j, q in enumerate(self._queues):
@@ -294,44 +584,121 @@ class FleetCoordinator:
             deadline = self._speculate_deadline_locked()
             return any(
                 s.state == "inflight" and not s.done and not s.speculated
+                and s.children is None
                 and i not in s.running and i not in s.failed_on
                 and now - s.started > deadline
                 for s in self._shards
             )
         return False
 
-    def _requeue_locked(self, shard: _ShardState, avoid: int) -> None:
+    def _weighted_target_locked(self, cands: list[int]) -> int:
+        """Least *weighted* queued bytes wins: the controller's placement
+        weight divides a replica's apparent load, so a down-weighted
+        (drowning) replica looks fuller than its raw bytes say."""
+        return min(
+            cands,
+            key=lambda j: (
+                sum(s.spec.nbytes for s in self._queues[j])
+                / max(0.05, self._weights.get(self.cfg.hosts[j], 1.0)),
+                j,
+            ),
+        )
+
+    def _place_fragment_locked(self, child: _ShardState, avoid) -> None:
+        n = len(self._queues)
+        cands = [
+            j for j in range(n)
+            if j not in avoid and not self._draining[j]
+        ]
+        if not cands:
+            cands = [j for j in range(n) if not self._draining[j]] \
+                or list(range(n))
+        child.state = "queued"
+        self._insert_sorted(self._queues[self._weighted_target_locked(cands)],
+                            child)
+
+    def _requeue_locked(self, shard: _ShardState, avoid: int,
+                        redispatch: bool = True) -> None:
         """Re-dispatch a failed shard to a survivor's queue (the replica
-        with the least queued bytes that hasn't already failed it;
-        everyone-failed resets the slate so breaker probes can retry it
-        until the attempt cap declares it dead)."""
+        with the least weighted queued bytes that hasn't already failed
+        it and isn't draining; everyone-failed resets the slate so
+        breaker probes can retry it until the attempt cap declares it
+        dead). ``redispatch=False`` is the drain hand-back: same routing,
+        but the move is clean bookkeeping, not a failure retry."""
         n = len(self._queues)
         cands = [
             j for j in range(n)
             if j != avoid and j not in shard.failed_on
+            and not self._draining[j]
         ]
         if not cands:
             shard.failed_on.clear()
-            cands = [j for j in range(n) if j != avoid] or list(range(n))
-        target = min(
-            cands,
-            key=lambda j: (sum(s.spec.nbytes for s in self._queues[j]), j),
-        )
+            cands = [
+                j for j in range(n) if j != avoid and not self._draining[j]
+            ] or [j for j in range(n) if j != avoid] or list(range(n))
+        target = self._weighted_target_locked(cands)
         shard.state = "queued"
         shard.speculated = False
-        self.stats["redispatches"] += 1
+        if redispatch:
+            self.stats["redispatches"] += 1
         self._insert_sorted(self._queues[target], shard)
+
+    def _note_draining_locked(self, i: int) -> None:
+        """Replica ``i`` reported draining: stop assigning it work and
+        hand its queued shards back to survivors. Shards it already
+        accepted either finish (drain waits for running jobs) or come
+        back via the rejected→hand-back path; a replica that dies instead
+        of draining cleanly is the breaker ladder's half."""
+        if i >= len(self._draining) or self._draining[i]:
+            return
+        self._draining[i] = True
+        self.stats["drains"] += 1
+        handed = list(self._queues[i])
+        self._queues[i].clear()
+        for s in handed:
+            self._place_fragment_locked(s, avoid={i})
+        logger.info(
+            "replica %s draining: %d queued shard(s) handed back",
+            self.cfg.hosts[i], len(handed),
+        )
+
+    def _resolve_split_locked(self, shard: _ShardState) -> None:
+        """Settle the parent/fragments race after ``shard`` completed.
+        Parent finished first → the whole-shard result wins outright:
+        every fragment is marked superseded and its blobs (even completed
+        ones) are dropped, so no path can fold twice. Last fragment
+        finished first → the parent is resolved by its children and its
+        still-racing attempt cancels on the next poll."""
+        if shard.children is not None:
+            for c in shard.children:
+                if not c.done:
+                    c.done = True
+                    c.state = "done"
+                c.resolved_by = "parent"
+                c.blobs = None
+                for q in self._queues:
+                    if c in q:
+                        q.remove(c)
+            return
+        p = shard.parent
+        if p is not None and not p.done and all(
+            c.done and c.resolved_by == "self" for c in p.children
+        ):
+            p.done = True
+            p.state = "done"
+            p.resolved_by = "children"
 
     def _declare_fleet_dead_locked(self) -> None:
         """All breakers open at once: every queued shard (and every
         in-flight shard with no attempt still running) goes to the local
         fallback; attempts still racing resolve themselves (their own
-        failure paths land here again)."""
+        failure paths land here again). Split parents are skipped — their
+        fragments cover the same paths exactly once."""
         for q in self._queues:
             q.clear()
         for s in self._shards:
             if s.state in ("queued", "inflight") and not s.done \
-                    and not s.running:
+                    and not s.running and s.children is None:
                 s.state = "dead"
 
     # -- the fan-out ---------------------------------------------------------
@@ -352,7 +719,8 @@ class FleetCoordinator:
         # a shard that failed this many times (across redispatches and
         # breaker probes) is declared dead and handed to the fallback
         self._attempt_cap = max(4, 2 * n)
-        workers = [
+        self._ctx = ctx
+        self._workers = [
             threading.Thread(
                 target=self._worker, args=(i, ctx), daemon=True,
                 name=f"fleet-worker-r{i}-{j}",
@@ -364,19 +732,31 @@ class FleetCoordinator:
         # the telemetry plane is strictly optional: interval 0 means the
         # module is never imported, no thread starts, no gauges exist
         # (bench --smoke asserts exactly this), and the heartbeat's fleet
-        # fragment falls back to coordinator-local breaker state
+        # fragment falls back to coordinator-local breaker state; the
+        # placement controller rides the same gate — it is tickless and
+        # only the poller's scrape loop drives it
         poller = None
         if self.cfg.telemetry_interval > 0:
+            from trivy_tpu.fleet.controller import FleetController
             from trivy_tpu.fleet.telemetry import start_poller
 
+            self.controller = FleetController(
+                list(self.cfg.hosts), ctx=ctx,
+                interval=self.cfg.telemetry_interval,
+            )
             poller = start_poller(
                 self, ctx, interval=self.cfg.telemetry_interval
             )
+            if poller is not None:
+                poller.controller = self.controller
+        self._poller = poller
         ctx.fleet_status = lambda: self._fleet_status(poller)
         if poller is not None:
             ctx.fleet_live = poller.live_fragment
-        for w in workers:
-            w.start()
+        with self._cond:
+            self._running = True
+            for w in self._workers:
+                w.start()
         try:
             with self._cond:
                 while self._pending_locked() > 0:
@@ -389,8 +769,10 @@ class FleetCoordinator:
         finally:
             with self._cond:
                 self._stop = True
+                self._running = False
+                ws = list(self._workers)
                 self._cond.notify_all()
-            for w in workers:
+            for w in ws:
                 w.join(timeout=30.0)
             if poller is not None:
                 poller.stop()
@@ -399,7 +781,9 @@ class FleetCoordinator:
             self._fallback(dead, ctx)
         # fold the fan-out's shape into the trace counters so --trace /
         # --metrics-out carry the steal/speculation/redispatch story
-        for key in ("steals", "speculative", "redispatches"):
+        # (joins and placement decisions were counted live as they fired)
+        for key in ("steals", "speculative", "redispatches", "splits",
+                    "drains"):
             if self.stats[key]:
                 ctx.count(f"fleet.{key}", self.stats[key])
         # the verdict is computed whether or not tracing is on (bench
@@ -409,14 +793,20 @@ class FleetCoordinator:
             ctx.profile().note_fleet(self.verdict)
         out = {}
         for s in self._shards:
+            if s.resolved_by == "parent":
+                continue  # fragment superseded by its parent's win
+            if s.children is not None and s.resolved_by == "children":
+                continue  # split parent represented by its fragments
             if s.blobs is None:
                 raise FleetError(f"{s.spec.label()} completed without blobs")
             out[s.spec.index] = s.blobs
         logger.info(
             "fleet fan-out complete: %d shard(s) over %d replica(s) "
-            "(%d steal(s), %d speculative, %d redispatch(es), %d local)",
-            self.stats["shards"], n, self.stats["steals"],
+            "(%d steal(s), %d speculative, %d redispatch(es), %d split(s), "
+            "%d join(s), %d drain(s), %d local)",
+            self.stats["shards"], len(self.cfg.hosts), self.stats["steals"],
             self.stats["speculative"], self.stats["redispatches"],
+            self.stats["splits"], self.stats["joins"], self.stats["drains"],
             self.stats["local_fallback"],
         )
         return out
@@ -496,7 +886,12 @@ class FleetCoordinator:
                     if self._stop or self._pending_locked() == 0:
                         return
                     shard, how = (None, "")
-                    if not self.breaker.is_open(i):
+                    if self._draining[i]:
+                        # a draining replica takes no new work — its
+                        # in-flight jobs finish (drain waits for running
+                        # work) and its queue was already handed back
+                        pass
+                    elif not self.breaker.is_open(i):
                         shard, how = self._take_locked(i)
                     elif self._eligible_work_locked(i) \
                             and self.breaker.try_probe(i):
@@ -560,12 +955,30 @@ class FleetCoordinator:
                     f"{shard.spec.label()}"
                 )
         except Exception as e:
-            self.breaker.record_failure(i)
-            logger.warning(
-                "%s failed on replica %s (attempt %d): %s",
-                shard.spec.label(), host, shard.attempts, e,
-            )
-            fleet_dead = all(
+            drain = isinstance(e, ReplicaDraining)
+            if drain:
+                try:
+                    faults.check("fleet.drain", key=host)
+                except Exception as fe:
+                    # a faulted hand-back falls back to the breaker
+                    # ladder: the shard re-dispatches as a plain failure
+                    # — never lost, never double-completed
+                    logger.warning(
+                        "drain hand-back on %s faulted: %s", host, fe
+                    )
+                    drain = False
+            if drain:
+                logger.info(
+                    "%s handed back by draining replica %s",
+                    shard.spec.label(), host,
+                )
+            else:
+                self.breaker.record_failure(i)
+                logger.warning(
+                    "%s failed on replica %s (attempt %d): %s",
+                    shard.spec.label(), host, shard.attempts, e,
+                )
+            fleet_dead = not drain and all(
                 self.breaker.is_open(j) for j in range(len(self.cfg.hosts))
             )
             with self._cond:
@@ -573,9 +986,24 @@ class FleetCoordinator:
                 # counts toward the verdict's busy bucket
                 self._host_busy[host] += time.monotonic() - t0
                 shard.running.discard(i)
-                shard.failed_on.add(i)
+                if drain:
+                    # a clean drain is not a failure: no breaker penalty,
+                    # no failed_on mark — the worker gate keeps replica i
+                    # out of rotation and the shard re-routes
+                    self._note_draining_locked(i)
+                else:
+                    shard.failed_on.add(i)
                 if not shard.done and not shard.running:
-                    if fleet_dead or shard.attempts >= self._attempt_cap:
+                    if shard.children is not None:
+                        # a split parent's failed attempt defers to its
+                        # fragments — they cover the same paths, and
+                        # re-running the whole shard would race its own
+                        # children
+                        pass
+                    elif drain:
+                        self._requeue_locked(shard, avoid=i,
+                                             redispatch=False)
+                    elif fleet_dead or shard.attempts >= self._attempt_cap:
                         # exhausted everywhere: hand it to the fallback
                         shard.state = "dead"
                         logger.error(
@@ -597,9 +1025,11 @@ class FleetCoordinator:
         wall = time.monotonic() - t0
         with self._cond:
             self._host_busy[host] += wall
+            self._dead_marks[i] = False  # it answered; the verdict lapses
             shard.running.discard(i)
             if shard.done:
-                # a twin attempt already won; this result is the loser
+                # a twin attempt (or the other side of a split) already
+                # won; this result is the loser
                 self.stats["cancelled"] += 1
                 ctx.count("fleet.cancelled")
                 self._cond.notify_all()
@@ -607,6 +1037,7 @@ class FleetCoordinator:
             shard.done = True
             shard.state = "done"
             shard.blobs = list(blobs)
+            self._resolve_split_locked(shard)
             self._durations.append(wall)
             self.stats["replica_shards"][host] += 1
             self._host_last_done[host] = time.monotonic()
@@ -632,6 +1063,12 @@ class FleetCoordinator:
         with self._lock:
             delta = shard.spec.nbytes - shard.counted
             shard.counted = shard.spec.nbytes
+            if shard.parent is not None and delta > 0:
+                # a fragment's bytes also count against its parent so a
+                # later parent win folds only the remaining delta (the
+                # progress bar never double-counts a split)
+                p = shard.parent
+                p.counted = min(p.spec.nbytes, p.counted + delta)
         if delta > 0:
             ctx.progress().note_scanned(delta, files=0)
 
@@ -718,6 +1155,15 @@ class FleetCoordinator:
                 # the twin won, or the run was abandoned (timeout) —
                 # stop polling so worker joins don't outlive the scan
                 return None
+            if self._dead_marks[i]:
+                # the telemetry poller declared this replica dead (2
+                # consecutive failed scrapes): abandon the poll NOW so
+                # the shard re-dispatches instead of sitting parked in
+                # "dispatched" until the job timeout
+                raise RPCError(
+                    f"replica {self.cfg.hosts[i]} declared dead by "
+                    f"telemetry; abandoning job {job_id[:8]}"
+                )
             try:
                 doc = driver.fetch_result(job_id)
             except RPCError:
@@ -730,6 +1176,14 @@ class FleetCoordinator:
             status = doc.get("Status")
             if status == "done":
                 return doc.get("Result") or {}
+            if status == "rejected" and \
+                    "draining" in (doc.get("Error") or ""):
+                # the replica's admission queue handed the job back on
+                # SIGTERM — a clean drain, not a failure
+                raise ReplicaDraining(
+                    f"shard job {job_id[:8]} handed back: "
+                    f"{doc.get('Error')}"
+                )
             if status in ("failed", "expired", "rejected"):
                 raise RPCError(
                     f"shard job {job_id[:8]}: {status}: "
@@ -805,6 +1259,10 @@ class FleetCoordinator:
             shard.done = True
             shard.state = "done"
             shard.blobs = list(blobs)
+            with self._lock:
+                # a fallback-completed fragment may be the last one its
+                # split parent was waiting on
+                self._resolve_split_locked(shard)
             self.stats["local_fallback"] += 1
             ctx.count("fleet.local_fallback")
             if ctx.enabled:

@@ -200,6 +200,64 @@ def plan_fs_shards(root: str, option, scan_options,
     return shards, total_bytes, total_files
 
 
+def split_fs_shard(spec: ShardSpec, n: int = 2) -> list[ShardSpec] | None:
+    """Mid-scan re-plan of one fs shard into ``n`` byte-balanced
+    fragments at the SAME directory-atomic unit boundaries the original
+    plan used (:func:`group_units` — Helm chart subtrees stay whole), so
+    the fragment set is an exact partition of the shard's paths and the
+    merge through the applier stays byte-identical.
+
+    Fragment indexes interleave between the parent's and the next
+    integer (``index + k/(n+1)``) so the coordinator's sorted result
+    fold keeps plan order without renumbering untouched shards. Returns
+    None when the shard has fewer than 2 units (nothing to split at a
+    directory boundary) — image-layer shards are atomic by construction
+    and must never reach here.
+    """
+    if spec.wire.get("Kind") != "fs" or n < 2:
+        return None
+    root = spec.wire["Root"]
+    files = []
+    for rel in spec.wire["Paths"]:
+        try:
+            size = os.path.getsize(os.path.join(root, rel))
+        except OSError:
+            # a file deleted since the plan: carry it at zero weight —
+            # the replica's walker owns per-file error semantics, the
+            # split must not change WHICH paths are scanned
+            size = 0
+        files.append((rel, size))
+    units = group_units(files)
+    if len(units) < 2:
+        return None
+    n = min(n, len(units))
+    bins: list[list] = [[] for _ in range(n)]
+    loads = [0] * n
+    for key, unit_files, nbytes in sorted(
+        units, key=lambda u: (-u[2], u[0])
+    ):
+        i = min(range(n), key=lambda j: (loads[j], j))
+        bins[i].extend(unit_files)
+        loads[i] += nbytes
+    frags = []
+    order = sorted(range(n), key=lambda j: (-loads[j], j))
+    for k, j in enumerate(order):
+        if not bins[j]:
+            continue
+        wire = dict(spec.wire)
+        wire["Paths"] = sorted(rel for rel, _ in bins[j])
+        wire["Bytes"] = loads[j]
+        frags.append(
+            ShardSpec(
+                index=spec.index + (k + 1) / (n + 1),
+                kind="fs",
+                nbytes=loads[j],
+                wire=wire,
+            )
+        )
+    return frags if len(frags) >= 2 else None
+
+
 # -- image planning ----------------------------------------------------------
 
 

@@ -70,7 +70,15 @@ _FLEET_GAUGE_SPECS = (
     ("trivy_tpu_fleet_headroom",
      "Per-replica dispatch headroom score in [0,1] (0 = unreachable or "
      "breaker-open)"),
+    ("trivy_tpu_fleet_weight",
+     "Per-replica placement weight assigned by the fleet controller "
+     "(absent when headroom-weighted dispatch is off)"),
 )
+
+# consecutive failed scrapes before the poller declares a replica dead
+# and trips its breaker out-of-band (a replica that took work and died
+# must not park its shard in 'dispatched' until the job timeout)
+DEAD_SCRAPE_STREAK = 2
 
 
 def _fleet_gauge(name: str, help: str) -> obs_metrics.Gauge:
@@ -182,6 +190,11 @@ class ReplicaPoller:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._gauge_rows: set[str] = set()  # replica labels we ever set
+        # elastic plane hooks: the placement controller (set by the
+        # coordinator when headroom-weighted dispatch is on — the poller
+        # drives its ticks) and per-host dead-scrape streaks
+        self.controller = None
+        self._dead_streaks: dict[str, int] = {}
 
     # -- one tick ------------------------------------------------------------
 
@@ -189,6 +202,7 @@ class ReplicaPoller:
         from trivy_tpu.rpc.client import RPCError, get_metrics_text
 
         cfg = self.coord.cfg
+        self._sync_hosts()
         # a dead replica must not stall the tick for the default RPC
         # timeout: the scrape deadline tracks the poll cadence (floor
         # 0.5 s so a loaded replica still answers), so one vanished host
@@ -208,13 +222,61 @@ class ReplicaPoller:
                 logger.debug("telemetry scrape of %s failed: %s", host, e)
                 rh.breaker_open = True
                 rh.note_failure(t)
+                streak = self._dead_streaks.get(host, 0) + 1
+                self._dead_streaks[host] = streak
+                if streak >= DEAD_SCRAPE_STREAK:
+                    # the death verdict: trip the breaker NOW so the
+                    # shard this replica took re-dispatches instead of
+                    # sitting out the job timeout (note_replica_dead is
+                    # idempotent)
+                    note_dead = getattr(
+                        self.coord, "note_replica_dead", None
+                    )
+                    if note_dead is not None:
+                        note_dead(
+                            i, f"{streak} consecutive dead telemetry "
+                               f"scrapes"
+                        )
                 self._export(host, rh)
                 continue
+            self._dead_streaks[host] = 0
+            alive = getattr(self.coord, "note_replica_alive", None)
+            if alive is not None:
+                alive(i)
             rh.breaker_open = coord_open
             rh.note_scrape(t, parsed)
+            draining = parsed.get("trivy_tpu_server_draining")
+            if draining is not None and draining.samples \
+                    and draining.max() >= 1.0:
+                # the replica announced a clean drain on its own metrics:
+                # hand its queued shards back before the rejected-job
+                # round trips even land
+                note_drain = getattr(
+                    self.coord, "note_replica_draining", None
+                )
+                if note_drain is not None:
+                    note_drain(i)
             self._poll_progress(i, host, rh, t)
             rh.series.record("headroom", t, rh.headroom())
             self._export(host, rh)
+        ctrl = self.controller
+        if ctrl is not None:
+            # the controller is tickless — this scrape loop IS its clock
+            fired = ctrl.tick(
+                {h: self.health[h].headroom() for h in self.hosts}
+            )
+            apply_p = getattr(self.coord, "apply_placement", None)
+            if apply_p is not None:
+                apply_p(ctrl.weights(), len(fired))
+
+    def _sync_hosts(self) -> None:
+        """Pick up replicas that joined mid-sweep: the coordinator's host
+        list is append-only, so mirroring its tail keeps scrape indexes
+        aligned with breaker/driver slots."""
+        cur = list(self.coord.cfg.hosts)
+        for h in cur[len(self.hosts):]:
+            self.hosts.append(h)
+            self.health[h] = ReplicaHealth(h)
 
     def _poll_progress(self, i: int, host: str, rh: ReplicaHealth,
                        t: float) -> None:
@@ -244,6 +306,7 @@ class ReplicaPoller:
         """Mirror a replica's latest health to the coordinator-side
         ``trivy_tpu_fleet_*{replica=}`` gauges."""
         self._gauge_rows.add(host)
+        ctrl = self.controller
         vals = {
             "trivy_tpu_fleet_link_mbs": rh.last.get("link_mbs"),
             "trivy_tpu_fleet_device_busy_ratio":
@@ -253,6 +316,10 @@ class ReplicaPoller:
             "trivy_tpu_fleet_queue_depth": rh.last.get("queue_depth"),
             "trivy_tpu_fleet_breaker_open": 1.0 if rh.breaker_open else 0.0,
             "trivy_tpu_fleet_headroom": rh.headroom(),
+            # the weight row exists only when headroom-weighted dispatch
+            # is on (None skips the set; bench --smoke asserts no rows)
+            "trivy_tpu_fleet_weight":
+                ctrl.weights().get(host) if ctrl is not None else None,
         }
         for name, help in _FLEET_GAUGE_SPECS:
             v = vals[name]
@@ -309,10 +376,13 @@ class ReplicaPoller:
     # -- aggregated surfaces -------------------------------------------------
 
     def fleet_doc(self) -> dict:
-        return {
+        doc = {
             "interval_s": self.interval,
             "replicas": {h: self.health[h].to_doc() for h in self.hosts},
         }
+        if self.controller is not None:
+            doc["controller"] = self.controller.doc()
+        return doc
 
     def live_fragment(self) -> str:
         """Compact per-replica status for the ``--live`` line, e.g.

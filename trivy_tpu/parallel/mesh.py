@@ -214,6 +214,42 @@ class CircuitBreaker:
         with self._lock:
             return [i for i in range(self.n) if self._open[i]]
 
+    def grow(self, label: str | None = None) -> int:
+        """Append one closed slot (live replica join on an elastic fleet):
+        the new device starts healthy with a fresh backoff ladder. Returns
+        the new slot's index."""
+        with self._lock:
+            i = self.n
+            self.n += 1
+            self.labels.append(label or f"d{i}")
+            self._fails.append(0)
+            self._open.append(False)
+            self._open_until.append(0.0)
+            self._backoff.append(self.probe_backoff)
+            self._probing.append(False)
+            self._probe_at.append(0.0)
+        _BREAKER_OPEN.setdefault(0, device=self.labels[i])
+        return i
+
+    def trip(self, i: int, reason: str = "") -> None:
+        """Force slot ``i`` open NOW (out-of-band death verdict, e.g. the
+        fleet telemetry poller observing consecutive dead scrapes) without
+        burning the consecutive-failure count: the half-open probe ladder
+        still governs recovery, so a replica that comes back is probed in
+        on the normal schedule."""
+        with self._lock:
+            if self._open[i]:
+                return
+            self._fails[i] = max(self._fails[i], self.threshold)
+            self._open[i] = True
+            self._open_until[i] = self.clock() + self._backoff[i]
+        _BREAKER_OPEN.set(1, device=self.labels[i])
+        logger.warning(
+            "device %s breaker TRIPPED%s; re-probing in %.1fs",
+            self.labels[i], f" ({reason})" if reason else "",
+            self._backoff[i],
+        )
+
 class DeviceBusyTracker:
     """Per-device busy-interval accounting for live utilization telemetry.
 

@@ -40,5 +40,12 @@ SCAN_RESULT_SUFFIX = "/result"
 def scan_result_path(job_id: str) -> str:
     return f"{SCAN_PROGRESS_PREFIX}{job_id}{SCAN_RESULT_SUFFIX}"
 
+
+# elastic fleet live-join seam: POST /fleet/register with {"Host": addr}
+# asks the coordinator embedded in this server to adopt a replica
+# mid-sweep; 404 unless a coordinator installed its hook, 403 on a bad
+# token, idempotent on duplicates
+FLEET_REGISTER = "/fleet/register"
+
 # ref: pkg/flag/server_flags.go default token header
 DEFAULT_TOKEN_HEADER = "Trivy-Token"

@@ -428,6 +428,36 @@ def get_result(server: str, job_id: str, token: str = "",
     return doc
 
 
+def get_healthz(server: str, token: str = "",
+                token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                deadline: float = POLL_TIMEOUT) -> dict:
+    """One ``GET /healthz`` probe — the coordinator's join-time liveness
+    check for a registering replica. Fail-fast like every poll: a dead
+    joiner must be refused within one probe, not after a retry ladder."""
+    base = server if "://" in server else f"http://{server}"
+    url = base.rstrip("/") + rpc.HEALTHZ
+    _, doc, _ = _get_json(
+        url, token, token_header, min(deadline, POLL_TIMEOUT),
+        f"healthz {server}",
+    )
+    return doc
+
+
+def post_register(server: str, host: str, token: str = "",
+                  token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                  timeout: float = 30.0, retries: int = MAX_RETRIES,
+                  deadline: float = RETRY_DEADLINE) -> dict:
+    """Announce replica ``host`` to the coordinator at ``server``
+    (``POST /fleet/register``). Rides the normal full-jitter retry
+    ladder — the seam is idempotent server-side (a duplicate register
+    answers ``Known: true``), so a retry after a lost 200 is safe."""
+    return _post(
+        server if "://" in server else f"http://{server}",
+        rpc.FLEET_REGISTER, {"Host": host}, token, token_header,
+        timeout, retries, deadline,
+    )
+
+
 class RemoteCache:
     """Cache facade backed by the server's Cache service
     (ref: pkg/cache/remote.go) — what client-side analysis writes to."""

@@ -258,6 +258,13 @@ class ScanServer:
         # "draining" (load balancers stop routing) and new RPC requests
         # get 503 + Retry-After; in-flight scans run to completion
         self.draining = False
+        # elastic fleet live-join seam: a coordinator embedded in this
+        # process installs its register_replica here; None keeps
+        # POST /fleet/register a plain 404 with ZERO register state
+        # (bench --smoke asserts it). An optional dedicated token gates
+        # the seam independently of the scan token
+        self.fleet_register_hook = None
+        self.fleet_register_token = ""
         # live progress registry for GET /scan/<trace_id>/progress:
         # in-flight scans map trace id -> their ScanProgress; finished
         # scans keep a bounded table of final snapshots for late pollers
@@ -633,6 +640,9 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             if self.path == rpc.SCAN_SUBMIT:
                 self._handle_submit()
                 return
+            if self.path == rpc.FLEET_REGISTER:
+                self._handle_fleet_register()
+                return
             method = _ROUTES.get(self.path)
             if method is None:
                 self._reply(404, {"error": f"no such route: {self.path}"})
@@ -709,6 +719,64 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 time.perf_counter() - t0, method=method
             )
             self._reply(code, payload, headers=reply_headers)
+
+        def _handle_fleet_register(self) -> None:
+            """POST /fleet/register — the elastic fleet's live-join seam.
+            404 unless a coordinator installed its hook (a plain replica
+            server keeps zero register state); gated by the same
+            ``_token_ok`` path as every authenticated route — or by the
+            dedicated register token when one is set — answering 403 on a
+            mismatch (the seam is an operator surface; a wrong token here
+            is a misconfigured joiner, not an unauthenticated scan)."""
+            hook = server.fleet_register_hook
+            if hook is None:
+                self._reply(
+                    404, {"error": "no fleet coordinator on this server"}
+                )
+                return
+            if server.draining:
+                self._reply(
+                    503, {"error": "server is draining"},
+                    headers={"Retry-After": "1"},
+                )
+                return
+            reg_token = server.fleet_register_token
+            if reg_token:
+                presented = self.headers.get(token_header, "")
+                ok = hmac.compare_digest(
+                    presented.encode("latin-1", "replace"),
+                    reg_token.encode("latin-1", "replace"),
+                )
+            else:
+                ok = self._token_ok()
+            if not ok:
+                self._reply(403, {"error": "invalid token"})
+                return
+            raw, err = self._read_body()
+            if err is not None:
+                self._reply(*err)
+                return
+            try:
+                req = json.loads(raw or b"{}")
+            except ValueError as e:
+                self._reply(400, {"error": f"bad request: {e}"})
+                return
+            host = req.get("Host") if isinstance(req, dict) else None
+            if not host or not isinstance(host, str):
+                self._reply(400, {"error": "bad request: Host required"})
+                return
+            try:
+                doc = hook(host)
+            except Exception as e:
+                # a refused join (dead joiner, injected fault) answers
+                # loudly and leaves the running fan-out untouched
+                logger.warning("fleet register of %s refused: %s", host, e)
+                self._reply(502, {"error": str(e)})
+                return
+            server.metrics.requests.inc(
+                method="fleet_register", code="200"
+            )
+            self._reply(200, doc)
 
         def _handle_submit(self) -> None:
             """POST /scan/submit — the async half of the job API."""

@@ -68,6 +68,14 @@ DEFAULT_TUNING_INTERVAL = 0.5
 # replica's own sampler cadence anyway
 DEFAULT_FLEET_TELEMETRY_INTERVAL = 1.0
 
+# mid-scan shard re-planning (--fleet-split-threshold /
+# TRIVY_TPU_FLEET_SPLIT_THRESHOLD, 0 = off): an in-flight fs shard whose
+# wall exceeds this x the median shard estimate while its replica shows
+# no headroom is split at a directory boundary and the remainder
+# re-scattered to survivors. Above the speculate multiplier (2.0) by
+# design: a full-copy twin is cheaper than a re-plan, so it gets first go
+DEFAULT_FLEET_SPLIT_THRESHOLD = 3.0
+
 # knobs TuningConfig owns; order is the canonical display/serialize order
 KNOBS = (
     "feed_streams", "inflight", "arena_slabs", "bucket_rungs", "parallel",
@@ -164,6 +172,9 @@ class TuningConfig:
     # fleet replica-poller cadence (0 = off: no poller thread, no parser
     # import, no fleet gauges); only consulted in --fleet mode
     fleet_telemetry_interval: float = DEFAULT_FLEET_TELEMETRY_INTERVAL
+    # straggler split multiplier over the median shard estimate (0 = no
+    # mid-scan re-planning); only consulted in --fleet mode
+    fleet_split_threshold: float = DEFAULT_FLEET_SPLIT_THRESHOLD
     topology: str = ""                # fingerprint this config resolved for
     autotune_path: str | None = None  # record file consulted (if any)
     # per-knob provenance: cli | env | autotune | default
@@ -185,6 +196,7 @@ class TuningConfig:
             "controller": self.controller,
             "tuning_interval": self.tuning_interval,
             "fleet_telemetry_interval": self.fleet_telemetry_interval,
+            "fleet_split_threshold": self.fleet_split_threshold,
             "topology": self.topology,
             "source": dict(self.source),
         }
@@ -409,6 +421,16 @@ def resolve_tuning(opts: dict | None = None, env: dict | None = None,
         cfg.fleet_telemetry_interval = validate_interval(
             raw_fiv,
             "--fleet-telemetry-interval/TRIVY_TPU_FLEET_TELEMETRY_INTERVAL",
+        )
+    # straggler split multiplier: same ladder, explicit 0 turns mid-scan
+    # re-planning off (validate_interval's >= 0 contract fits exactly)
+    raw_fst = opts.get("fleet_split_threshold")
+    if raw_fst is None:
+        raw_fst = env.get("TRIVY_TPU_FLEET_SPLIT_THRESHOLD") or None
+    if raw_fst is not None:
+        cfg.fleet_split_threshold = validate_interval(
+            raw_fst,
+            "--fleet-split-threshold/TRIVY_TPU_FLEET_SPLIT_THRESHOLD",
         )
     if record is not None and any(
         s == "autotune" for s in cfg.source.values()
