@@ -1209,7 +1209,11 @@ class TestZeroCostWhenOff:
                 urllib.request.urlopen(f"{base}/healthz").read()
             )
             assert "Admission" not in doc
-            assert sorted(doc) == [
+            # flight-recorder forensics fields are process-global and may
+            # surface here when earlier tests left error/degrade/breaker
+            # events in the ring — they are not an admission allocation
+            forensics = {"LastError", "LastDegraded", "LastBreakerTrip"}
+            assert sorted(k for k in doc if k not in forensics) == [
                 "InFlight", "Status", "UptimeSeconds", "Version",
             ]
         finally:

@@ -107,6 +107,13 @@ def global_flags() -> FlagGroup:
                  help="arm the deterministic fault-injection harness, e.g. "
                       "'device.dispatch@d3:times=-1,cache.redis.get:at=2' "
                       "(see trivy_tpu/faults.py for the grammar)"),
+            Flag("debug-dir", default=None, config_name="debug.dir",
+                 help="directory for auto-emitted flight-recorder "
+                      "diagnostic bundles (terminal failure, degraded "
+                      "completion, breaker trip, dead replica); bounded "
+                      "retention (TRIVY_TPU_DEBUG_KEEP, default 8); env "
+                      "TRIVY_TPU_DEBUG_DIR; render with "
+                      "`trivy-tpu debug <bundle>`"),
         ],
     )
 
@@ -516,6 +523,7 @@ _TARGET_GROUPS = {
     "sbom": [global_flags, scan_flags, report_flags, db_flags,
              server_client_flags],
     "convert": [global_flags, report_flags],
+    "debug": [global_flags],
     "server": [global_flags, db_flags, admission_flags],
     "clean": [global_flags],
 }
@@ -537,6 +545,8 @@ def build_parser() -> argparse.ArgumentParser:
         "vm": "scan a VM disk image (raw; MBR/GPT + ext4)",
         "sbom": "scan an SBOM (CycloneDX/SPDX) for vulnerabilities",
         "convert": "convert a saved JSON report into another format",
+        "debug": "render a flight-recorder diagnostic bundle "
+                 "(timeline + verdict)",
         "server": "run the scan server",
         "clean": "clean caches and databases",
     }
@@ -576,6 +586,9 @@ def build_parser() -> argparse.ArgumentParser:
                            help="image archive (docker save tar / OCI layout)")
             p.add_argument("target", nargs="?", default=None,
                            help="image archive path")
+        elif cmd == "debug":
+            p.add_argument("target",
+                           help="diagnostic bundle path (.json.gz or .json)")
         else:
             p.add_argument("target", help="scan target")
 

@@ -230,7 +230,12 @@ def run(command: str, ns, opts) -> int:
         trace_on or bool(opts.get("timeseries_out")) or bool(opts.get("live"))
     ) and telemetry_interval > 0 and command != "server"
     from trivy_tpu import faults
+    from trivy_tpu.obs import recorder as flight
 
+    # flight-recorder forensics destination (--debug-dir wins over
+    # TRIVY_TPU_DEBUG_DIR); without one, auto-emitted bundles stay off
+    if opts.get("debug_dir"):
+        flight.set_debug_dir(opts["debug_dir"])
     # arm the fault-injection harness for this run (--fault-inject /
     # TRIVY_TPU_FAULT_INJECT); disarmed again in the finally below so
     # library callers running several commands don't leak scripted faults
@@ -272,6 +277,8 @@ def run(command: str, ns, opts) -> int:
                 rc = _run_sbom(ns, opts)
             elif command == "convert":
                 rc = _run_convert(ns, opts)
+            elif command == "debug":
+                rc = _run_debug(ns, opts)
             elif command == "server":
                 rc = _run_server(ns, opts)
             elif command == "clean":
@@ -298,6 +305,18 @@ def run(command: str, ns, opts) -> int:
                 faults.clear()
             if timeout > 0 and command not in ("server", "watch"):
                 signal.alarm(0)
+            # failure forensics: a scan that died emits its black box; a
+            # scan that completed on a degraded path emits one too (the
+            # degradation is the story). auto_emit never raises and is a
+            # no-op without a debug dir
+            if not completed:
+                import sys as _sys
+
+                flight.auto_emit(
+                    "terminal-failure", ctx=ctx, error=_sys.exc_info()[1]
+                )
+            elif ctx.health_snapshot().get("scan.degraded"):
+                flight.auto_emit("degraded-completion", ctx=ctx)
             # telemetry teardown runs on EVERY exit path (completion, scan
             # death, timeout): stop the sampler (one final tick), then the
             # live line — no leaked threads. Progress is marked finished
@@ -648,6 +667,77 @@ def _run_sbom(ns, opts) -> int:
     driver = LocalDriver(cache, vuln_client=_vuln_client(opts))
     report = Scanner(artifact, driver).scan_artifact(_scan_options(opts))
     return _emit(report, ns, opts)
+
+
+def _run_debug(ns, opts) -> int:
+    """``trivy-tpu debug <bundle>``: render a flight-recorder diagnostic
+    bundle (auto-emitted under ``--debug-dir``, or pulled from a replica
+    via ``GET /debug/bundle``) as the machine verdict plus a relative
+    event timeline and the device-lane/stall summaries."""
+    import datetime
+
+    from trivy_tpu.obs import recorder as flight
+
+    try:
+        doc = flight.read_bundle(ns.target)
+    except (OSError, ValueError) as e:
+        logger.error("cannot read bundle %s: %s", ns.target, e)
+        return 1
+    out = sys.stdout
+    w = out.write
+    w(f"bundle:  {ns.target}\n")
+    w(f"schema:  {doc.get('schema', '?')}\n")
+    w(f"reason:  {doc.get('reason', '?')}\n")
+    w(f"created: {doc.get('created', '?')}\n")
+    w(f"scan:    {doc.get('name', '?')} "
+      f"(trace {str(doc.get('trace_id', ''))[:8]})\n")
+    if doc.get("error"):
+        w(f"error:   {doc['error']}\n")
+    w("\nverdict\n  " + str(doc.get("verdict", "(none)")) + "\n")
+    events = doc.get("events") or doc.get("process_events") or []
+    if events:
+        w(f"\ntimeline ({len(events)} event(s))\n")
+        t0 = events[0].get("t", 0.0)
+        for ev in events:
+            ts = datetime.datetime.fromtimestamp(
+                ev.get("t", 0.0), datetime.timezone.utc
+            ).strftime("%H:%M:%S")
+            line = (f"  +{ev.get('t', 0.0) - t0:8.2f}s {ts} "
+                    f"{ev.get('kind', '?'):8s} {ev.get('what', '')}")
+            detail = ev.get("detail")
+            if detail:
+                line += "  " + " ".join(
+                    f"{k}={v}" for k, v in detail.items()
+                )
+            w(line + "\n")
+    dev = doc.get("device")
+    if dev:
+        w("\ndevice lane\n")
+        w(f"  compiles: {dev.get('compile_total', 0)} "
+          f"({dev.get('compile_wall_s', 0.0)}s wall) across "
+          f"{len(dev.get('compiles', {}))} kernel(s)\n")
+        for kern, row in sorted((dev.get("compiles") or {}).items()):
+            w(f"    {kern}: {row.get('count', 0)} compile(s), "
+              f"{row.get('wall_s', 0.0)}s\n")
+        storms = dev.get("recompile_storms") or []
+        if storms:
+            w(f"  RECOMPILE STORMS: {', '.join(storms)} "
+              f"(threshold {dev.get('storm_threshold')})\n")
+        hbm = dev.get("hbm") or {}
+        if hbm:
+            w(f"  hbm: {hbm}\n")
+    stall = doc.get("stall")
+    if stall:
+        w(f"\nstall attribution\n  {stall}\n")
+    replicas = doc.get("replica_bundles")
+    if replicas:
+        w(f"\nreplica bundles ({len(replicas)})\n")
+        for host, sub in sorted(replicas.items()):
+            if "error" in sub and "verdict" not in sub:
+                w(f"  {host}: pull failed: {sub['error']}\n")
+            else:
+                w(f"  {host}: {sub.get('verdict', '(no verdict)')}\n")
+    return 0
 
 
 def _run_convert(ns, opts) -> int:

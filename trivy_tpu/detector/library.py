@@ -13,6 +13,7 @@ import functools
 
 from trivy_tpu import log
 from trivy_tpu.db import Advisory
+from trivy_tpu.obs import recorder as flight
 from trivy_tpu.types import Application, DetectedVulnerability
 from trivy_tpu.version import compare, parse_constraints, satisfies
 from trivy_tpu.version.compare import Constraint
@@ -114,6 +115,11 @@ class _CompiledPrefix:
         dev = jax.device_put(mat)
         self.upload_bytes += mat.nbytes
         _count_bounds_upload(mat.nbytes)
+        # HBM ledger: widest-only residency — the narrower buffer this
+        # replaces is released from the ledger with it
+        if cached is not None:
+            flight.release_resident("cve", getattr(cached[1], "nbytes", 0))
+        flight.note_resident("cve", mat.nbytes)
         self._bounds_dev = (w, dev)
         return dev, w
 
@@ -619,6 +625,9 @@ class _ResidentJoin:
         dev = jax.device_put(mat)
         self.upload_bytes += mat.nbytes
         _count_bounds_upload(mat.nbytes)
+        if cached is not None:
+            flight.release_resident("cve", getattr(cached[1], "nbytes", 0))
+        flight.note_resident("cve", mat.nbytes)
         self._bounds_dev = (w, dev)
         return dev, w
 

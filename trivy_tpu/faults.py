@@ -160,6 +160,15 @@ class FaultPlan:
                     )
                     break
         if err is not None:
+            # flight-recorder breadcrumb: the injected site lands in the
+            # ring BEFORE the raise, so a failure bundle's machine verdict
+            # names the faulted site directly
+            from trivy_tpu.obs import recorder as flight
+
+            flight.record(
+                "fault", f"{site}@{key}" if key else site,
+                {"error": type(err).__name__},
+            )
             raise err
 
     def fired(self) -> dict[str, int]:

@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 
 from trivy_tpu import faults, log, obs
 from trivy_tpu.fleet import FleetError, parse_fleet
+from trivy_tpu.obs import recorder as flight
 from trivy_tpu.fleet.plan import DEFAULT_SHARDS_PER_REPLICA, split_fs_shard
 from trivy_tpu.tuning import (
     DEFAULT_FLEET_SPLIT_THRESHOLD,
@@ -346,7 +347,38 @@ class FleetCoordinator:
             "replica %s joined the fleet mid-sweep (now %d replica(s))",
             host, len(self.cfg.hosts),
         )
+        flight.record("fleet", f"replica join {host}",
+                      {"replicas": len(self.cfg.hosts)})
         return {"Host": host, "Known": False,
+                "Replicas": len(self.cfg.hosts)}
+
+    def deregister_replica(self, host: str) -> dict:
+        """Explicit live leave: the inverse of :meth:`register_replica`.
+        Reuses the drain hand-back path — the replica takes no new work,
+        its queued shards re-scatter to survivors, and in-flight attempts
+        finish (or come back via the rejected hand-back). Idempotent: an
+        unknown or already-draining host is a no-op answer, never an
+        error (the leaver's retry ladder may re-POST)."""
+        hosts = parse_fleet(host)
+        if len(hosts) != 1:
+            raise FleetError(
+                f"deregister: exactly one replica address required, "
+                f"got {host!r}"
+            )
+        host = hosts[0]
+        with self._cond:
+            try:
+                i = self.cfg.hosts.index(host)
+            except ValueError:
+                return {"Host": host, "Known": False,
+                        "Replicas": len(self.cfg.hosts)}
+            already = self._draining[i]
+            if not already:
+                self._note_draining_locked(i)
+                self._cond.notify_all()
+        if not already:
+            logger.info("replica %s deregistered from the fleet", host)
+        return {"Host": host, "Known": True, "Draining": True,
                 "Replicas": len(self.cfg.hosts)}
 
     def note_replica_draining(self, i: int) -> None:
@@ -368,6 +400,18 @@ class FleetCoordinator:
             self._dead_marks[i] = True
             self._cond.notify_all()
         self.breaker.trip(i, reason or "2 consecutive dead telemetry scrapes")
+        host = self.cfg.hosts[i] if i < len(self.cfg.hosts) else f"r{i}"
+        flight.record(
+            "dead", f"fleet replica {host}",
+            {"reason": reason or "2 consecutive dead telemetry scrapes"},
+        )
+        # the forensics bundle for a dead replica merges that replica's
+        # own flight-recorder ring (best-effort — it may be truly dead,
+        # in which case the pull error itself is part of the story)
+        flight.auto_emit(
+            "dead-replica", ctx=self._ctx,
+            extra={"replica_bundles": self._pull_replica_bundles([host])},
+        )
 
     def note_replica_alive(self, i: int) -> None:
         """A successful scrape (or attempt) on a dead-marked replica: the
@@ -375,6 +419,23 @@ class FleetCoordinator:
         with self._lock:
             if i < len(self._dead_marks):
                 self._dead_marks[i] = False
+
+    def _pull_replica_bundles(self, hosts: list[str]) -> dict[str, dict]:
+        """Best-effort ``GET /debug/bundle`` against each named replica so
+        the coordinator's merged bundle carries the replica-side rings
+        too. A pull failure is recorded in place of the bundle — for a
+        dead replica the error IS the evidence."""
+        from trivy_tpu.rpc.client import fetch_debug_bundle
+
+        out: dict[str, dict] = {}
+        for h in hosts:
+            try:
+                out[h] = fetch_debug_bundle(
+                    h, token=self.cfg.token, deadline=self.cfg.rpc_deadline
+                )
+            except Exception as e:
+                out[h] = {"error": f"{type(e).__name__}: {e}"}
+        return out
 
     def apply_placement(self, weights: dict, fired: int = 0) -> None:
         """Controller output: swap in the placement weights consulted by
@@ -560,6 +621,8 @@ class FleetCoordinator:
             "(median %.1fs)", shard.spec.label(), len(children),
             now - shard.started, med,
         )
+        flight.record("fleet", f"shard split {shard.spec.label()}",
+                      {"fragments": len(children)})
         # largest fragment goes to this (idle) worker; the rest scatter
         # to survivors, weighted, avoiding the straggler's own owners
         for c in children[1:]:
@@ -653,14 +716,19 @@ class FleetCoordinator:
             return
         self._draining[i] = True
         self.stats["drains"] += 1
-        handed = list(self._queues[i])
-        self._queues[i].clear()
+        # a deregister can land before any scan scattered work (no
+        # per-replica queues yet): the drain mark alone is the whole story
+        handed = list(self._queues[i]) if i < len(self._queues) else []
+        if handed:
+            self._queues[i].clear()
         for s in handed:
             self._place_fragment_locked(s, avoid={i})
         logger.info(
             "replica %s draining: %d queued shard(s) handed back",
             self.cfg.hosts[i], len(handed),
         )
+        flight.record("fleet", f"replica drain {self.cfg.hosts[i]}",
+                      {"handed_back": len(handed)})
 
     def _resolve_split_locked(self, shard: _ShardState) -> None:
         """Settle the parent/fragments race after ``shard`` completed.

@@ -147,6 +147,12 @@ class _NullSpan:
 
 _NULL = _NullSpan()
 
+# flight-recorder span hook (trivy_tpu/obs/recorder.py): installed once at
+# recorder import when TRIVY_TPU_FLIGHT_RECORDER is on, so span boundaries
+# above the recorder's latency floor land in the black-box ring. One global
+# None-check per recorded span when off.
+_flight_hook = None
+
 
 class _SpanCM:
     __slots__ = ("ctx", "name", "sp")
@@ -270,6 +276,9 @@ class TraceContext:
                 self.events.append(sp)
             else:
                 self.dropped_events += 1
+        hook = _flight_hook
+        if hook is not None:
+            hook(self, sp)
 
     def span(self, name: str):
         """Context manager timing a block under ``name``; no-op when off."""
@@ -472,6 +481,8 @@ class TraceContext:
             self.tuning = None
             self.tuning_controller = None
             self.wire = None
+            if getattr(self, "_flight_ring", None) is not None:
+                self._flight_ring = None
 
     # -- aggregation --------------------------------------------------------
 
@@ -714,12 +725,14 @@ def note_scan_degraded() -> None:
     by every rung that degrades (device loop, license scorer, backend-init
     fallback) so the two surfaces cannot drift apart."""
     from trivy_tpu.obs import metrics as obs_metrics
+    from trivy_tpu.obs import recorder as _recorder
 
     current().health_count("scan.degraded")
     obs_metrics.REGISTRY.counter(
         "trivy_tpu_scan_degraded_total",
         "Scans that completed on a degraded (host-fallback) path",
     ).inc()
+    _recorder.record("degrade", "scan.degraded")
 
 
 def sample(name: str, value: float) -> None:
@@ -847,6 +860,17 @@ class heartbeat:
                 parts.append(frag)
             except Exception:
                 pass
+        # device fragment (flight recorder): compile count with per-beat
+        # delta and HBM residency; a recompile storm since the previous
+        # beat surfaces here immediately
+        try:
+            from trivy_tpu.obs import recorder as _recorder
+
+            frag = _recorder.heartbeat_fragment(self)
+            if frag:
+                parts.append(frag)
+        except Exception:
+            pass
         return " [" + ", ".join(parts) + "]"
 
     def _loop(self) -> None:

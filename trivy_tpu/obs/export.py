@@ -34,6 +34,7 @@ import json
 import os
 
 from trivy_tpu.obs import TraceContext, percentile, wire_span_stats
+from trivy_tpu.obs import recorder as _recorder
 from trivy_tpu.obs import stall as _stall
 
 # bounds for the wire form of a context (a scan response rides HTTP):
@@ -247,6 +248,11 @@ def chrome_trace_events(ctx: TraceContext) -> list[dict]:
         )
     if ts is not None:
         emit_counters(1, ts.to_doc())
+    # device-lane counter tracks (flight recorder): cumulative compile
+    # count and HBM-resident bytes over the scan timeline
+    dev_series = _recorder.counter_series(ctx)
+    if dev_series:
+        emit_counters(1, dev_series)
     local_tuning = ctx.tuning_doc()
     if local_tuning is not None:
         emit_tuning(1, local_tuning)
@@ -376,6 +382,13 @@ def metrics_dict(ctx: TraceContext) -> dict:
         # the gate/fallback byte counters behind it — only present when the
         # codec actually ran, so compression-off exports stay byte-identical
         doc["wire"] = wire
+    dev = _recorder.device_doc()
+    if dev is not None:
+        # device-lane accounting (flight recorder): compile ledger per
+        # (kernel, shape-bucket), recompile storms, and the HBM residency
+        # ledger — only present when the recorder observed device work, so
+        # recorder-off exports stay byte-identical
+        doc["device"] = dev
     fleet = getattr(ctx, "fleet", None)
     if fleet:
         # fleet telemetry plane: per-replica headroom/health summaries

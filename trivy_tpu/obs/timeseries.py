@@ -583,6 +583,17 @@ class LiveProgress:
                 parts.append(fleet_live())
             except Exception:
                 pass
+        # device-lane column: compile count + HBM residency from the
+        # flight recorder ("compiles 12 hbm 61%", plus a STORM marker
+        # the moment a recompile storm is detected mid-scan)
+        try:
+            from trivy_tpu.obs import recorder as _recorder
+
+            dev = _recorder.live_fragment()
+            if dev:
+                parts.append(dev)
+        except Exception:
+            pass
         # online-tuning column: current knob set + decision count, so an
         # operator watching --live sees every mid-scan adaptation land
         ctl = getattr(self.ctx, "tuning_controller", None)

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trivy_tpu.obs import recorder as flight
 from trivy_tpu.secret.compress import (
     MAX_EXPANSION,
     MODE_PACK7,
@@ -96,4 +97,4 @@ def build_decompress_fn(chunk_len: int, tab_bytes: np.ndarray,
             buf, offs, clen, mode
         )
 
-    return jax.jit(decompress)
+    return flight.instrument_jit("ops.decompress", decompress)

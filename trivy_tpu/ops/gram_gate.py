@@ -166,7 +166,9 @@ def build_byte_gate_fn(
         hit = hit | (chunks >= 128)
         return hit.reshape(B, C // BLK, BLK).any(axis=2)
 
-    jitted = jax.jit(gate)
+    from trivy_tpu.obs import recorder as flight
+
+    jitted = flight.instrument_jit("ops.gram_gate", gate)
 
     def fn(chunks):
         return jitted(chunks)

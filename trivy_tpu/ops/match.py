@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trivy_tpu.obs import recorder as flight
 from trivy_tpu.secret.device_compile import CompiledRules
 
 _ALNUM_INTERVALS = [(48, 57), (65, 90), (97, 122)]
@@ -206,4 +207,4 @@ def build_match_fn(compiled: CompiledRules, chunk_len: int,
         ]
         return jnp.stack(cols, axis=1) if cols else jnp.zeros((B, 0), dtype=bool)
 
-    return jax.jit(fn)
+    return flight.instrument_jit("ops.match", fn)

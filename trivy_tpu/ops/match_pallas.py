@@ -42,6 +42,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from trivy_tpu.obs import recorder as flight
 from trivy_tpu.ops.match import _ALNUM_INTERVALS, _intervals
 from trivy_tpu.secret.device_compile import CompiledRules, Variant
 
@@ -289,13 +290,11 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int,
             kernels.append(make_kernel([], keywords=tuple(kws[i : i + KEYWORD_BATCH])))
     if not kernels:
         # every rule is host-lane: nothing to check on device
-        @jax.jit
         def no_op(chunks: jax.Array) -> jax.Array:
             return jnp.zeros((chunks.shape[0], R), dtype=bool)
 
-        return no_op
+        return flight.instrument_jit("ops.match_pallas", no_op)
 
-    @jax.jit
     def fn(chunks: jax.Array) -> jax.Array:
         B = chunks.shape[0]
         assert B % BLOCK_ROWS == 0, f"batch {B} not a multiple of {BLOCK_ROWS}"
@@ -325,4 +324,4 @@ def build_match_fn_pallas(compiled: CompiledRules, chunk_len: int,
             )
         return functools.reduce(jnp.maximum, partials).astype(bool)
 
-    return fn
+    return flight.instrument_jit("ops.match_pallas", fn)

@@ -511,9 +511,13 @@ class DeviceScorer:
         self.mesh = mesh
         score = build_score_fn(table.lic_per_shard)
         host_arrays = (table.keys, table.credit)
+        from trivy_tpu.obs import recorder as flight
+
         if mesh is None:
-            self._fn = jax.jit(score)
-            self._gate = jax.jit(build_gate_fn())
+            self._fn = flight.instrument_jit("ops.ngram_score", score)
+            self._gate = flight.instrument_jit(
+                "ops.ngram_gate", build_gate_fn()
+            )
             self.corpus_device = tuple(jax.device_put(a) for a in host_arrays)
             self.data_parallelism = 1
         else:
@@ -535,6 +539,11 @@ class DeviceScorer:
                 for a in host_arrays
             )
             self.data_parallelism = int(mesh.shape["data"])
+        # HBM ledger: the corpus commit is the license lane's resident
+        # footprint (uploaded once per process, lives across scans)
+        flight.note_resident(
+            "corpus", sum(int(a.nbytes) for a in host_arrays)
+        )
         self.dispatch_count = 0  # telemetry: distinct device dispatches
 
     def __call__(self, rows: np.ndarray):
@@ -904,6 +913,15 @@ class DeviceBytesScorer:
                 jax.device_put(b, rep) for b in blooms
             )
             self.data_parallelism = int(mesh.shape["data"])
+        # HBM ledger: corpus table + shingle blooms are the raw-bytes
+        # lane's once-per-process resident footprint
+        from trivy_tpu.obs import recorder as flight
+
+        flight.note_resident(
+            "corpus",
+            sum(int(a.nbytes)
+                for a in (table.keys, table.credit, *blooms)),
+        )
         self.dispatch_count = 0
         self.upload_bytes = 0  # telemetry: row bytes that crossed the link
 
@@ -935,7 +953,9 @@ class DeviceBytesScorer:
         if fn is None:
             gate = build_bytes_gate_fn(width, self.table.lut)
             if self.mesh is None:
-                fn = jax.jit(gate)
+                from trivy_tpu.obs import recorder as flight
+
+                fn = flight.instrument_jit("ops.bytes_gate", gate)
             else:
                 from trivy_tpu.parallel.mesh import sharded_bytes_gate_fn
 
@@ -959,7 +979,9 @@ class DeviceBytesScorer:
                 t.p1, t.p2, t.hash_p, t.ngram,
             )
             if self.mesh is None:
-                fn = jax.jit(score)
+                from trivy_tpu.obs import recorder as flight
+
+                fn = flight.instrument_jit("ops.bytes_score", score)
             else:
                 from trivy_tpu.parallel.mesh import sharded_bytes_score_fn
 
@@ -986,7 +1008,11 @@ class DeviceBytesScorer:
         shape = (rows_dev.shape, int(out_rows))
         fn = self._take_fns.get(shape)
         if fn is None:
-            fn = jax.jit(lambda arr, i: jnp.take(arr, i, axis=0))
+            from trivy_tpu.obs import recorder as flight
+
+            fn = flight.instrument_jit(
+                "ops.take_rows", lambda arr, i: jnp.take(arr, i, axis=0)
+            )
             self._take_fns[shape] = fn
         full = np.zeros(out_rows, dtype=np.int32)
         full[: len(idx)] = idx

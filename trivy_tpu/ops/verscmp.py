@@ -13,13 +13,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trivy_tpu.obs import recorder as flight
+
 # op codes for constraint checks
 OPS = {"<": 0, "<=": 1, ">": 2, ">=": 3, "=": 4, "!=": 5}
 
 
-@jax.jit
-def lexcmp(a: jax.Array, b: jax.Array) -> jax.Array:
-    """[N, L] vs [N, L] int32 -> sign [N] in {-1, 0, 1}."""
+def _lexcmp(a: jax.Array, b: jax.Array) -> jax.Array:
     diff = jnp.sign(a - b)  # [-1, 0, 1] per position
     ne = diff != 0
     first = jnp.argmax(ne, axis=1)  # first differing position (0 if none)
@@ -27,33 +27,44 @@ def lexcmp(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.where(ne.any(axis=1), picked, 0)
 
 
-@jax.jit
-def check_ops(a: jax.Array, b: jax.Array, ops: jax.Array) -> jax.Array:
-    """Evaluate ``a <op> b`` per row -> bool [N]."""
-    s = lexcmp(a, b)
+def _check_ops(a: jax.Array, b: jax.Array, ops: jax.Array) -> jax.Array:
+    s = _lexcmp(a, b)
     return jnp.stack(
         [s < 0, s <= 0, s > 0, s >= 0, s == 0, s != 0], axis=1
     )[jnp.arange(s.shape[0]), ops]
 
 
-@jax.jit
-def check_ops_gather(
+def _check_ops_gather(
     inst: jax.Array, bounds: jax.Array, a_idx: jax.Array, b_idx: jax.Array,
     ops: jax.Array,
 ) -> jax.Array:
-    """``inst[a_idx] <op> bounds[b_idx]`` per row -> bool [R].
-
-    The gather runs on device so the static advisory-bound matrix stays
-    HBM-resident across scans; per scan only the (tiny) unique-installed
-    matrix and the int32 index/op rows cross the link — the layout SURVEY
-    §7 calls for (hot shards device-resident, host ships indices).
-    """
     a = jnp.take(inst, a_idx, axis=0)
     b = jnp.take(bounds, b_idx, axis=0)
-    s = lexcmp(a, b)
+    s = _lexcmp(a, b)
     return jnp.stack(
         [s < 0, s <= 0, s > 0, s >= 0, s == 0, s != 0], axis=1
     )[jnp.arange(s.shape[0]), ops]
+
+
+# public jitted entry points: the pure bodies above cross-call each other
+# un-jitted so compile accounting only sees host-side dispatches, never a
+# nested trace
+
+#: [N, L] vs [N, L] int32 -> sign [N] in {-1, 0, 1}.
+lexcmp = flight.instrument_jit("detector.lexcmp", _lexcmp)
+
+#: Evaluate ``a <op> b`` per row -> bool [N].
+check_ops = flight.instrument_jit("detector.check_ops", _check_ops)
+
+#: ``inst[a_idx] <op> bounds[b_idx]`` per row -> bool [R].
+#:
+#: The gather runs on device so the static advisory-bound matrix stays
+#: HBM-resident across scans; per scan only the (tiny) unique-installed
+#: matrix and the int32 index/op rows cross the link — the layout SURVEY
+#: §7 calls for (hot shards device-resident, host ships indices).
+check_ops_gather = flight.instrument_jit(
+    "detector.check_ops_gather", _check_ops_gather
+)
 
 
 def _next_bucket(n: int, floor: int = 256) -> int:

@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from trivy_tpu import faults, log, obs
 from trivy_tpu.obs import metrics as obs_metrics
+from trivy_tpu.obs import recorder as flight
 
 logger = log.logger("parallel:mesh")
 
@@ -124,6 +125,7 @@ class CircuitBreaker:
 
     def record_failure(self, i: int) -> None:
         _DEVICE_FAILURES.inc(device=self.labels[i])
+        opened = 0
         with self._lock:
             self._fails[i] += 1
             if self._open[i]:
@@ -144,12 +146,19 @@ class CircuitBreaker:
             elif self._fails[i] >= self.threshold:
                 self._open[i] = True
                 self._open_until[i] = self.clock() + self._backoff[i]
+                opened = self._fails[i]
                 _BREAKER_OPEN.set(1, device=self.labels[i])
                 logger.warning(
                     "device %s breaker OPEN after %d consecutive failures; "
                     "re-probing in %.1fs",
                     self.labels[i], self._fails[i], self._backoff[i],
                 )
+        if opened:
+            flight.record(
+                "breaker", f"device {self.labels[i]} OPEN",
+                {"fails": opened},
+            )
+            flight.auto_emit("breaker-trip")
 
     def record_success(self, i: int) -> None:
         with self._lock:
@@ -161,6 +170,7 @@ class CircuitBreaker:
         if was_open:
             _BREAKER_OPEN.set(0, device=self.labels[i])
             logger.info("device %s recovered; breaker closed", self.labels[i])
+            flight.record("breaker", f"device {self.labels[i]} closed")
 
     def next_device(self, start: int) -> int | None:
         """First dispatchable device scanning round-robin from ``start``:
@@ -249,6 +259,11 @@ class CircuitBreaker:
             self.labels[i], f" ({reason})" if reason else "",
             self._backoff[i],
         )
+        flight.record(
+            "breaker", f"device {self.labels[i]} OPEN",
+            {"forced": True, "reason": reason},
+        )
+        flight.auto_emit("breaker-trip")
 
 class DeviceBusyTracker:
     """Per-device busy-interval accounting for live utilization telemetry.
@@ -353,10 +368,11 @@ def sharded_match_fn(match_fn, mesh: Mesh, rows_multiple: int = 1):
     caller-visible output gather rides ICI. Batch size must be padded to a
     multiple of data_parallelism * rows_multiple (see :func:`pad_batch`).
     """
-    fn = jax.jit(
+    fn = flight.instrument_jit(
+        "mesh.sharded_match",
         _shard_map(
             match_fn, mesh=mesh, in_specs=(P("data", None),), out_specs=P("data", None)
-        )
+        ),
     )
 
     def run(chunks: np.ndarray) -> jax.Array:
@@ -424,7 +440,7 @@ def round_robin_match_fn(
     devices = list(devices) if devices is not None else jax.local_devices()
     if not devices:
         raise ValueError("round_robin_match_fn needs at least one device")
-    fn = jax.jit(match_fn)
+    fn = flight.instrument_jit("mesh.round_robin_match", match_fn)
     lock = threading.Lock()
     state = {"next": 0}
     breaker = breaker or CircuitBreaker(len(devices))
@@ -524,7 +540,7 @@ class StagedDispatch:
                 fn, mesh=self.mesh, in_specs=(P("data", None),),
                 out_specs=spec_out,
             )
-        self._stages[name] = jax.jit(fn)
+        self._stages[name] = flight.instrument_jit(f"stage.{name}", fn)
 
     def has_stage(self, name: str) -> bool:
         return name in self._stages
@@ -645,7 +661,8 @@ def sharded_score_fn(score_fn, mesh: Mesh):
     (rows, keys, credit) -> (full_w, phrase_hits). Batch size must be a
     multiple of the mesh data parallelism (see ``run.data_parallelism``).
     """
-    fn = jax.jit(
+    fn = flight.instrument_jit(
+        "mesh.sharded_score",
         _shard_map(
             score_fn,
             mesh=mesh,
@@ -655,7 +672,7 @@ def sharded_score_fn(score_fn, mesh: Mesh):
                 P("model", None, None),  # credit [m, Ku, 2*Ls]
             ),
             out_specs=(P("data", "model"), P("data", "model")),
-        )
+        ),
     )
 
     def run(rows, keys, credit):
@@ -670,13 +687,14 @@ def sharded_gate_fn(gate_fn, mesh: Mesh):
     over 'model'; ``gate_fn`` must be built with ``psum_axis='model'``
     (ops/ngram_score.build_gate_fn) so per-shard intersection counts
     reduce to global counts over ICI."""
-    fn = jax.jit(
+    fn = flight.instrument_jit(
+        "mesh.sharded_gate",
         _shard_map(
             gate_fn,
             mesh=mesh,
             in_specs=(P("data", None), P("model", None)),
             out_specs=P("data"),
-        )
+        ),
     )
 
     def run(rows, keys):
@@ -691,13 +709,14 @@ def sharded_bytes_gate_fn(gate_fn, mesh: Mesh):
     build_bytes_gate_fn): uint8 text rows over 'data', the two shingle
     blooms replicated (they are corpus-global, not per-shard); the
     per-row outputs come back partitioned over 'data' only."""
-    fn = jax.jit(
+    fn = flight.instrument_jit(
+        "mesh.sharded_bytes_gate",
         _shard_map(
             gate_fn,
             mesh=mesh,
             in_specs=(P("data", None), P(), P()),
             out_specs=(P("data", None), P("data"), P("data")),
-        )
+        ),
     )
 
     def run(rows, bloom8, bloom4):
@@ -721,7 +740,8 @@ def sharded_bytes_score_fn(score_fn, mesh: Mesh):
         n_uniq = jax.lax.pmax(n_uniq, axis_name="model")
         return full_w, phrase, n_uniq
 
-    fn = jax.jit(
+    fn = flight.instrument_jit(
+        "mesh.sharded_bytes_score",
         _shard_map(
             body,
             mesh=mesh,
@@ -731,7 +751,7 @@ def sharded_bytes_score_fn(score_fn, mesh: Mesh):
                 P("model", None, None),  # credit [m, Ku, 2*Ls]
             ),
             out_specs=(P("data", "model"), P("data", "model"), P("data")),
-        )
+        ),
     )
 
     def run(rows, keys, credit):
@@ -749,11 +769,12 @@ def hit_counts_psum(match_fn, mesh: Mesh):
         local = jnp.sum(hits.astype(jnp.int32), axis=0)  # [R]
         return jax.lax.psum(local, axis_name="data")
 
-    return jax.jit(
+    return flight.instrument_jit(
+        "mesh.hit_counts_psum",
         _shard_map(
             step,
             mesh=mesh,
             in_specs=(P("data", None),),
             out_specs=P(),
-        )
+        ),
     )

@@ -47,5 +47,17 @@ def scan_result_path(job_id: str) -> str:
 # token, idempotent on duplicates
 FLEET_REGISTER = "/fleet/register"
 
+# the explicit inverse: POST /fleet/deregister with {"Host": addr} asks
+# the coordinator to drain that replica out of rotation (queued shards
+# hand back to survivors, in-flight attempts finish). Same 404/403/
+# idempotency contract as register
+FLEET_DEREGISTER = "/fleet/deregister"
+
+# flight-recorder forensics pull: GET /debug/bundle returns this
+# process's on-demand diagnostic bundle (ring dump, compile/HBM ledgers,
+# verdict) as JSON; token-gated like the per-scan routes, 404 when the
+# recorder is disabled
+DEBUG_BUNDLE = "/debug/bundle"
+
 # ref: pkg/flag/server_flags.go default token header
 DEFAULT_TOKEN_HEADER = "Trivy-Token"

@@ -60,6 +60,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 from trivy_tpu import faults, log
+from trivy_tpu.obs import recorder as flight
 
 logger = log.logger("rpc:admission")
 
@@ -555,6 +556,12 @@ class AdmissionController:
             (ahead + 1) / rate
         ))))
 
+    def _note_shed(self, tenant: str, reason: str) -> None:
+        """One funnel for every shed decision: the Prometheus counter and
+        the flight-recorder ring see the same event."""
+        self.shed.inc(tenant=tenant, reason=reason)
+        flight.record("shed", f"admission {reason}", {"tenant": tenant})
+
     # -- synchronous admission (the blocking Scanner.Scan POST) -------------
 
     def try_acquire(self, tenant: Tenant) -> str | None:
@@ -562,15 +569,15 @@ class AdmissionController:
         the shed reason. Sync requests never queue — a shed tells the
         client *when* to retry instead of parking its connection."""
         if self._shed_for_breakers():
-            self.shed.inc(tenant=tenant.name, reason="breakers-open")
+            self._note_shed(tenant.name, "breakers-open")
             return "breakers-open"
         with self._cond:
             if self._running >= self.cfg.max_concurrent:
-                self.shed.inc(tenant=tenant.name, reason="concurrency")
+                self._note_shed(tenant.name, "concurrency")
                 return "concurrency"
             if (self._tenant_inflight.get(tenant.name, 0)
                     >= self._tenant_inflight_limit(tenant)):
-                self.shed.inc(tenant=tenant.name, reason="tenant-inflight")
+                self._note_shed(tenant.name, "tenant-inflight")
                 return "tenant-inflight"
             self._running += 1
             self._tenant_inflight[tenant.name] = (
@@ -616,7 +623,7 @@ class AdmissionController:
                     return 202, self._submit_doc(jid, tenant, position), {}
 
         def _shed(reason: str) -> tuple[int, dict, dict]:
-            self.shed.inc(tenant=tenant.name, reason=reason)
+            self._note_shed(tenant.name, reason)
             ra = self.retry_after()
             logger.info(
                 "shed submit from tenant %s: %s (queue %d, Retry-After %d)",

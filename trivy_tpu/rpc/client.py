@@ -458,6 +458,38 @@ def post_register(server: str, host: str, token: str = "",
     )
 
 
+def post_deregister(server: str, host: str, token: str = "",
+                    token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                    timeout: float = 30.0, retries: int = MAX_RETRIES,
+                    deadline: float = RETRY_DEADLINE) -> dict:
+    """Withdraw replica ``host`` from the coordinator at ``server``
+    (``POST /fleet/deregister``) — the explicit inverse of
+    :func:`post_register`. Idempotent server-side (an unknown or
+    already-draining host answers cleanly), so the retry ladder is safe
+    here too."""
+    return _post(
+        server if "://" in server else f"http://{server}",
+        rpc.FLEET_DEREGISTER, {"Host": host}, token, token_header,
+        timeout, retries, deadline,
+    )
+
+
+def fetch_debug_bundle(server: str, token: str = "",
+                       token_header: str = rpc.DEFAULT_TOKEN_HEADER,
+                       deadline: float = POLL_TIMEOUT) -> dict:
+    """One ``GET /debug/bundle`` pull of a replica's flight-recorder
+    bundle (ring dump, compile/HBM ledgers, verdict). Fail-fast like
+    every poll: the coordinator calls this against a replica it just
+    declared dead, so a hung pull must not stall the forensics path."""
+    base = server if "://" in server else f"http://{server}"
+    url = base.rstrip("/") + rpc.DEBUG_BUNDLE
+    _, doc, _ = _get_json(
+        url, token, token_header, min(deadline, POLL_TIMEOUT),
+        f"debug bundle {server}",
+    )
+    return doc
+
+
 class RemoteCache:
     """Cache facade backed by the server's Cache service
     (ref: pkg/cache/remote.go) — what client-side analysis writes to."""

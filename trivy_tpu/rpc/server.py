@@ -265,6 +265,9 @@ class ScanServer:
         # the seam independently of the scan token
         self.fleet_register_hook = None
         self.fleet_register_token = ""
+        # the explicit inverse seam: a coordinator installs its
+        # deregister_replica here; same 404-when-absent contract
+        self.fleet_deregister_hook = None
         # live progress registry for GET /scan/<trace_id>/progress:
         # in-flight scans map trace id -> their ScanProgress; finished
         # scans keep a bounded table of final snapshots for late pollers
@@ -553,6 +556,16 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 }
                 if server.admission is not None:
                     doc["Admission"] = server.admission.doc()
+                # flight-recorder forensics: the last error / degraded /
+                # breaker-trip events (with timestamps) from the ring, so
+                # one /healthz poll answers "what happened last" without
+                # pulling a full bundle
+                try:
+                    from trivy_tpu.obs import recorder as _flight
+
+                    doc.update(_flight.healthz_doc())
+                except Exception:
+                    pass
                 self._reply(200, doc)
                 return
             if self.path == rpc.VERSION:
@@ -630,6 +643,25 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                     return
                 self._reply(code, payload, headers=headers or None)
                 return
+            if self.path == rpc.DEBUG_BUNDLE:
+                # forensics pull: the fleet coordinator fetches a dead or
+                # degraded replica's ring this way and merges it into its
+                # own bundle. Token-gated like the per-scan routes (the
+                # ring names scan targets); 404 with the recorder off —
+                # the disabled path must keep allocating nothing
+                if not self._token_ok():
+                    self._reply(403, {"error": "invalid token"})
+                    return
+                from trivy_tpu.obs import recorder as _flight
+
+                if not _flight.enabled():
+                    self._reply(404, {"error": "flight recorder disabled"})
+                    return
+                try:
+                    self._reply(200, _flight.build_bundle(reason="on-demand"))
+                except Exception as e:
+                    self._reply(500, {"error": str(e)})
+                return
             self._reply(404, {"error": "not found"})
 
         def do_POST(self):
@@ -642,6 +674,9 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
                 return
             if self.path == rpc.FLEET_REGISTER:
                 self._handle_fleet_register()
+                return
+            if self.path == rpc.FLEET_DEREGISTER:
+                self._handle_fleet_deregister()
                 return
             method = _ROUTES.get(self.path)
             if method is None:
@@ -728,13 +763,31 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             dedicated register token when one is set — answering 403 on a
             mismatch (the seam is an operator surface; a wrong token here
             is a misconfigured joiner, not an unauthenticated scan)."""
-            hook = server.fleet_register_hook
+            self._handle_fleet_hook(
+                server.fleet_register_hook, "fleet register",
+                "fleet_register",
+            )
+
+        def _handle_fleet_deregister(self) -> None:
+            """POST /fleet/deregister — the explicit inverse of register.
+            Same 404/403/400 contract; the hook (the coordinator's
+            ``deregister_replica``) reuses the drain hand-back path and is
+            idempotent, so a leaver's retry ladder re-POSTing is safe.
+            Deliberately NOT refused while draining: a coordinator server
+            winding down must still let replicas leave cleanly."""
+            self._handle_fleet_hook(
+                server.fleet_deregister_hook, "fleet deregister",
+                "fleet_deregister", allow_draining=True,
+            )
+
+        def _handle_fleet_hook(self, hook, label: str, method: str,
+                               allow_draining: bool = False) -> None:
             if hook is None:
                 self._reply(
                     404, {"error": "no fleet coordinator on this server"}
                 )
                 return
-            if server.draining:
+            if server.draining and not allow_draining:
                 self._reply(
                     503, {"error": "server is draining"},
                     headers={"Retry-After": "1"},
@@ -768,14 +821,12 @@ def _make_handler(server: ScanServer, token: str, token_header: str):
             try:
                 doc = hook(host)
             except Exception as e:
-                # a refused join (dead joiner, injected fault) answers
-                # loudly and leaves the running fan-out untouched
-                logger.warning("fleet register of %s refused: %s", host, e)
+                # a refused join/leave (dead joiner, injected fault)
+                # answers loudly and leaves the running fan-out untouched
+                logger.warning("%s of %s refused: %s", label, host, e)
                 self._reply(502, {"error": str(e)})
                 return
-            server.metrics.requests.inc(
-                method="fleet_register", code="200"
-            )
+            server.metrics.requests.inc(method=method, code="200")
             self._reply(200, doc)
 
         def _handle_submit(self) -> None:

@@ -188,6 +188,12 @@ class HitStore:
                         "invisible by design",
                         fp[:16], ", ".join(f[:16] for f in fps[-3:]),
                     )
+                    from trivy_tpu.obs import recorder as flight
+
+                    flight.record(
+                        "cold", "warm-store cold start",
+                        {"fingerprint": fp[:16]},
+                    )
                 fps = (fps + [fp])[-self.MARKER_FPS:]
                 self.backend.put_blob(marker_key, {"fps": fps})
         except Exception as e:  # the store is an accelerator, never a dep

@@ -100,6 +100,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from trivy_tpu import faults, log, obs
+from trivy_tpu.obs import recorder as flight
 from trivy_tpu.ops.match import build_match_fn
 from trivy_tpu.secret.compress import COMPRESS_MIN_RATIO, CompressedSlab
 from trivy_tpu.secret.device_compile import CompiledRules, compile_rules
@@ -972,6 +973,10 @@ class _ScanRun:
             + ARENA_MARGIN,
         )
         self.arena = ChunkArena(slabs, sc.batch_size, sc.chunk_len)
+        # HBM ledger: the arena bound is the worst-case device residency
+        # of in-flight batch rows (every slab's rows may be device-side at
+        # once across the dispatch windows); released at close()
+        flight.note_resident("arena", slabs * sc.batch_size * sc.chunk_len)
         self.pool = ThreadPoolExecutor(max_workers=sc.confirm_workers)
         # backpressure: bounds queued+running confirms so a slow confirm
         # pool cannot accumulate unbounded _FileState.data on a large
@@ -1046,7 +1051,13 @@ class _ScanRun:
         self.inflight = max(1, min(self._max_inflight, int(n)))
 
     def grow_arena(self, k: int) -> int:
-        return self.arena.grow(int(k), self._max_arena_slabs)
+        before = self.arena.n_slabs
+        n = self.arena.grow(int(k), self._max_arena_slabs)
+        if n > before:
+            flight.note_resident(
+                "arena", (n - before) * self.arena.rows * self.arena.row_len
+            )
+        return n
 
     def _telemetry_probe(self) -> dict[str, float]:
         """In-flight pipeline state for the telemetry sampler: arena
@@ -1094,6 +1105,9 @@ class _ScanRun:
                 break
             if item is not None and item is not _ABORT:
                 self.arena.release(item[0])
+        flight.release_resident(
+            "arena", self.arena.n_slabs * self.arena.rows * self.arena.row_len
+        )
         # feed-path introspection for tests and bench debugging: on a
         # clean scan every slab is back in the arena (no leak into the
         # streaming-RSS budget) and acquires ≫ slabs proves reuse
@@ -1504,6 +1518,10 @@ class _ScanRun:
                 stats.add(batch_splits=1)
                 if self.enabled:
                     ctx.count("secret.batch_splits")
+                flight.record(
+                    "oom", "secret.batch_split",
+                    {"rows": len(meta), "error": str(err)},
+                )
                 logger.warning(
                     "device OOM on a %d-row batch (%s); splitting and "
                     "re-dispatching the halves", len(meta), err,
@@ -1520,6 +1538,11 @@ class _ScanRun:
                 stats.add(batch_retries=1)
                 if self.enabled:
                     ctx.count("secret.batch_retries")
+                flight.record(
+                    "retry", "secret.batch_retry",
+                    {"rows": len(meta), "attempt": retries + 1,
+                     "error": str(err)},
+                )
                 logger.warning(
                     "device error on a %d-row batch (retry %d/%d): %s",
                     len(meta), retries + 1, sc._batch_retries, err,

@@ -673,6 +673,11 @@ class TuningController:
             self._doc["decisions"] = len(self.decisions) + self.dropped
             if self.dropped:
                 self._doc["dropped"] = self.dropped
+        from trivy_tpu.obs import recorder as flight
+
+        flight.record(
+            "tuning", f"{rule}: {knob} {int(old)}->{int(new)}", ctx=self.ctx,
+        )
         return d
 
     def _apply(self, rule: str, g: dict, t: float) -> list[dict]:
